@@ -1,0 +1,23 @@
+"""flexflow_tpu: a TPU-native auto-parallel DNN training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of FlexFlow (the
+Legion/CUDA reference surveyed in SURVEY.md): Keras/PyTorch-style FFModel API,
+two-phase graph compiler (Layer graph -> Parallel Computation Graph), Unity
+auto-parallelization search over a TPU cost model, first-class parallel
+operators, MoE building blocks, and torch-fx/ONNX/Keras frontends.
+"""
+from .config import FFConfig, FFIterationConfig  # noqa: F401
+from .ffconst import (ActiMode, AggrMode, CompMode, DataType, LossType,  # noqa: F401
+                      MetricsType, OperatorType, ParameterSyncType, PoolType)
+from .tensor import Tensor  # noqa: F401
+from .layer import Layer  # noqa: F401
+from .machine_view import MachineView, MachineResource  # noqa: F401
+from .parallel_tensor import ParallelDim, ParallelTensorShape  # noqa: F401
+from .model import FFModel  # noqa: F401
+from .execution.optimizers import SGDOptimizer, AdamOptimizer  # noqa: F401
+from .execution.metrics import PerfMetrics  # noqa: F401
+from .execution.initializers import (GlorotUniformInitializer,  # noqa: F401
+                                     ZeroInitializer, ConstantInitializer,
+                                     UniformInitializer, NormInitializer)
+
+__version__ = "0.1.0"

@@ -1,0 +1,198 @@
+"""Runtime configuration and CLI flag parsing.
+
+TPU-native analog of the reference's ``FFConfig`` (include/flexflow/config.h:93-162)
+and ``FFConfig::parse_args`` (src/runtime/model.cc:~3530-3700). Flag names are kept
+reference-compatible, including the Legion-style ``-ll:*`` resource flags, which here
+select TPU devices instead of GPUs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .ffconst import CompMode
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration knobs (reference: config.h:164-169)."""
+
+    seq_length: int = -1
+
+    def reset(self) -> None:
+        self.seq_length = -1
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """All runtime configuration (reference: config.h:93-162).
+
+    Device terminology: ``workers_per_node`` counts accelerator chips per host
+    (the reference's GPUs-per-node); on TPU a "worker" is one chip.
+    """
+
+    # training loop
+    epochs: int = 1
+    batch_size: int = 64
+    print_freq: int = 10
+    dataset_path: str = ""
+
+    # devices / topology
+    num_nodes: int = 1
+    workers_per_node: int = 0  # 0 = use all visible devices
+    cpus_per_node: int = 1
+    device_memory_mb: int = 0  # analog of -ll:fsize; 0 = query from device
+
+    # auto-parallelization search (Unity)
+    search_budget: int = -1
+    search_alpha: float = 1.05
+    search_overlap_backward_update: bool = False
+    computation_mode: CompMode = CompMode.COMP_MODE_TRAINING
+    only_data_parallel: bool = False
+    enable_sample_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = True
+    search_num_nodes: int = -1
+    search_num_workers: int = -1
+    base_optimize_threshold: int = 10
+    enable_control_replication: bool = True
+    python_data_loader_type: int = 2
+
+    # fusion & memory search
+    perform_fusion: bool = False
+    perform_memory_search: bool = False
+
+    # machine model for the simulator
+    machine_model_version: int = 0
+    machine_model_file: str = ""
+    simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
+    simulator_segment_size: int = 16777216
+    simulator_max_num_segments: int = 1
+
+    # strategy import/export (reference: config.h:143-148)
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    export_strategy_task_graph_file: str = ""
+    export_strategy_computation_graph_file: str = ""
+    include_costs_dot_graph: bool = False
+    substitution_json_path: Optional[str] = None
+
+    # observability
+    profiling: bool = False
+    perform_auto_mapping: bool = False
+
+    # TPU-native knobs (no reference analog)
+    mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
+    mesh_axis_names: Sequence[str] = ("data", "model")
+    allow_mixed_precision: bool = True  # bf16 compute where safe
+    seed: int = 42
+
+    iteration_config: FFIterationConfig = dataclasses.field(
+        default_factory=FFIterationConfig
+    )
+
+    def __post_init__(self) -> None:
+        argv = sys.argv[1:] if "pytest" not in os.path.basename(sys.argv[0]) else []
+        self.parse_args(argv)
+        if self.workers_per_node == 0:
+            try:
+                import jax
+
+                self.workers_per_node = max(1, len(jax.devices()) // self.num_nodes)
+            except Exception:
+                self.workers_per_node = 1
+
+    # -- reference-compatible flag parsing (model.cc:~3530-3700) ---------------
+    def parse_args(self, argv: List[str]) -> None:
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+
+            def _next() -> str:
+                nonlocal i
+                i += 1
+                if i >= len(argv):
+                    raise ValueError(f"flag {a} expects a value")
+                return argv[i]
+
+            if a in ("-e", "--epochs"):
+                self.epochs = int(_next())
+            elif a in ("-b", "--batch-size"):
+                self.batch_size = int(_next())
+            elif a in ("-p", "--print-freq"):
+                self.print_freq = int(_next())
+            elif a in ("-d", "--dataset"):
+                self.dataset_path = _next()
+            elif a == "--budget" or a == "--search-budget":
+                self.search_budget = int(_next())
+            elif a == "--alpha" or a == "--search-alpha":
+                self.search_alpha = float(_next())
+            elif a == "--only-data-parallel":
+                self.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                self.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                self.enable_attribute_parallel = True
+            elif a == "--fusion":
+                self.perform_fusion = True
+            elif a == "--memory-search":
+                self.perform_memory_search = True
+            elif a == "--overlap":
+                self.search_overlap_backward_update = True
+            elif a == "--import" or a == "--import-strategy":
+                self.import_strategy_file = _next()
+            elif a == "--export" or a == "--export-strategy":
+                self.export_strategy_file = _next()
+            elif a == "--machine-model-version":
+                self.machine_model_version = int(_next())
+            elif a == "--machine-model-file":
+                self.machine_model_file = _next()
+            elif a == "--simulator-workspace-size":
+                self.simulator_work_space_size = int(_next())
+            elif a == "--substitution-json":
+                self.substitution_json_path = _next()
+            elif a == "--search-num-nodes":
+                self.search_num_nodes = int(_next())
+            elif a == "--search-num-workers":
+                self.search_num_workers = int(_next())
+            elif a == "--base-optimize-threshold":
+                self.base_optimize_threshold = int(_next())
+            elif a == "--enable-propagation":
+                pass  # legacy MCMC propagation; accepted for compatibility
+            elif a == "--disable-control-replication":
+                self.enable_control_replication = False
+            elif a == "--nodes":
+                self.num_nodes = int(_next())
+            elif a == "--profiling":
+                self.profiling = True
+            elif a == "--taskgraph":
+                self.export_strategy_task_graph_file = _next()
+            elif a == "--include-costs-dot-graph":
+                self.include_costs_dot_graph = True
+            elif a == "--compgraph":
+                self.export_strategy_computation_graph_file = _next()
+            elif a == "-ll:gpu" or a == "-ll:tpu":
+                self.workers_per_node = int(_next())
+            elif a == "-ll:cpu":
+                self.cpus_per_node = int(_next())
+            elif a == "-ll:fsize":
+                self.device_memory_mb = int(_next())
+            elif a in ("-ll:zsize", "-ll:util", "-ll:py", "-lg:prof"):
+                _next()  # accepted and ignored on TPU
+            elif a == "--seed":
+                self.seed = int(_next())
+            elif a == "--mesh-shape":
+                self.mesh_shape = tuple(int(x) for x in _next().split("x"))
+            # unrecognized flags are ignored, matching the reference's behavior
+            i += 1
+
+    # -- derived properties -----------------------------------------------------
+    @property
+    def total_workers(self) -> int:
+        return self.num_nodes * self.workers_per_node
+
+    def numpy_seed(self) -> int:
+        return self.seed

@@ -1,0 +1,1 @@
+from .dataloader import SingleDataLoader, batch_iterator  # noqa: F401

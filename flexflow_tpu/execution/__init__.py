@@ -1,0 +1,3 @@
+from .optimizers import SGDOptimizer, AdamOptimizer, Optimizer  # noqa: F401
+from .metrics import Metrics, PerfMetrics  # noqa: F401
+from .losses import Loss, loss_value  # noqa: F401

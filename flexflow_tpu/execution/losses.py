@@ -1,0 +1,53 @@
+"""Loss functions.
+
+Reference: src/loss_functions/loss_functions.cc — ``Loss::backward`` seeds
+dLoss/dlogits for 4 loss types (enum ffconst.h:39-45) with hand-written CUDA
+kernels. TPU-native: the loss is a scalar-valued pure function; sharded
+autodiff derives the seed, and when the batch dim is sharded XLA inserts the
+cross-shard mean (the reference's scale-by-1/batch + PS/NCCL reduction).
+"""
+from __future__ import annotations
+
+from ..ffconst import LossType
+
+
+class Loss:
+    """API-parity wrapper (reference: include/flexflow/loss_functions.h)."""
+
+    def __init__(self, loss_type: LossType, repl_labels: bool = False):
+        self.loss_type = loss_type
+        # replicate labels when final op is AGG_SPEC (reference model.cc:2875-2877)
+        self.repl_labels = repl_labels
+
+    def __call__(self, logits, labels):
+        return loss_value(self.loss_type, logits, labels, self.repl_labels)
+
+
+def loss_value(loss_type: LossType, logits, labels, repl_labels: bool = False):
+    import jax.numpy as jnp
+    import jax.nn as jnn
+
+    if repl_labels:
+        k = logits.shape[0] // labels.shape[0]
+        labels = jnp.repeat(labels, k, axis=0)
+
+    if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        # logits here are post-softmax probabilities (the reference applies
+        # softmax as a graph op and the loss consumes probs, loss_functions.cu)
+        labels = labels.reshape(labels.shape[0])
+        logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+        nll = -jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[:, None], axis=-1)
+        return jnp.mean(nll)
+    if loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
+        logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.square(logits - labels))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
+        # sum over features, mean over batch (reference: mse sum-reduce kernel)
+        return jnp.mean(jnp.sum(jnp.square(logits - labels),
+                                axis=tuple(range(1, logits.ndim))))
+    if loss_type == LossType.LOSS_IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(f"unknown loss {loss_type}")

@@ -1,0 +1,86 @@
+"""Training metrics.
+
+Reference: src/metrics_functions/metrics_functions.cc — per-shard GPU compute
+of ``PerfMetrics`` (metrics_functions.h:25-44) folded on CPU by
+UPDATE_METRICS_TASK. TPU-native: metrics are computed inside the jitted train
+step (sharded reduction is a psum XLA inserts); ``PerfMetrics`` accumulates the
+per-step device scalars host-side, read lazily like the reference's Future.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from ..ffconst import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Accumulated counters (reference: metrics_functions.h:25-44)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: Dict[str, float]) -> None:
+        self.train_all += int(other.get("train_all", 0))
+        self.train_correct += int(other.get("train_correct", 0))
+        for f in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss",
+                  "mae_loss"):
+            setattr(self, f, getattr(self, f) + float(other.get(f, 0.0)))
+
+    def accuracy(self) -> float:
+        return self.train_correct / max(self.train_all, 1)
+
+    def mean(self, field: str) -> float:
+        return getattr(self, field) / max(self.train_all, 1)
+
+
+class Metrics:
+    """reference: include/flexflow/metrics_functions.h — a loss type + a list
+    of MetricsType computed against the final op's output."""
+
+    def __init__(self, loss_type: LossType, metrics: List[MetricsType]):
+        self.loss_type = loss_type
+        self.measures = list(metrics)
+
+    def compute(self, logits, labels) -> Dict[str, object]:
+        """Device-side per-batch metrics; returns dict of scalars
+        (reference: Metrics::compute, metrics_functions.cc:68)."""
+        import jax.numpy as jnp
+
+        out: Dict[str, object] = {"train_all": logits.shape[0]}
+        sparse = self.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+        for m in self.measures:
+            if m == MetricsType.METRICS_ACCURACY:
+                pred = jnp.argmax(logits, axis=-1)
+                if sparse:
+                    ref = labels.reshape(labels.shape[0]).astype(pred.dtype)
+                else:
+                    ref = jnp.argmax(labels, axis=-1)
+                out["train_correct"] = jnp.sum(pred == ref)
+            elif m == MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
+                li = labels.reshape(labels.shape[0]).astype(jnp.int32)
+                logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+                out["sparse_cce_loss"] = -jnp.sum(
+                    jnp.take_along_axis(logp, li[:, None], axis=-1))
+            elif m == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
+                logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+                out["cce_loss"] = -jnp.sum(labels * logp)
+            elif m == MetricsType.METRICS_MEAN_SQUARED_ERROR:
+                out["mse_loss"] = jnp.sum(
+                    jnp.mean(jnp.square(logits - labels),
+                             axis=tuple(range(1, logits.ndim))))
+            elif m == MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR:
+                out["rmse_loss"] = jnp.sum(jnp.sqrt(
+                    jnp.mean(jnp.square(logits - labels),
+                             axis=tuple(range(1, logits.ndim)))))
+            elif m == MetricsType.METRICS_MEAN_ABSOLUTE_ERROR:
+                out["mae_loss"] = jnp.sum(
+                    jnp.mean(jnp.abs(logits - labels),
+                             axis=tuple(range(1, logits.ndim))))
+        return out

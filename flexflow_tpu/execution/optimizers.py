@@ -1,0 +1,115 @@
+"""Optimizers: SGD (momentum/nesterov) and Adam.
+
+Reference: src/runtime/optimizer.cc (608 LoC) + optimizer_kernel.cu — per-weight
+update tasks in two sync modes (parameter-server and NCCL allreduce,
+optimizer_kernel.cu:88,196). TPU-native: a pure ``(params, grads, state) ->
+(params, state)`` pytree transform; gradient synchronization disappears into
+sharded autodiff (psum on the data axis), so both reference sync modes collapse
+into the same code path. The FlexFlow class surface (SGDOptimizer/AdamOptimizer
+with ``next()`` per-step hyperparameter schedule, optimizer.h:27-96) is kept.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class Optimizer:
+    def init_state(self, params):
+        raise NotImplementedError
+
+    def next(self, state):
+        """Per-step hyperparameter advance (reference: AdamOptimizer::next,
+        optimizer.cc — updates alpha_t); returns new state."""
+        return state
+
+    def update(self, params, grads, state):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    """reference: optimizer.h:36-60 (lr, momentum, nesterov, weight_decay)."""
+
+    def __init__(self, ffmodel=None, lr: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, weight_decay: float = 0.0):
+        self.lr = lr
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        import jax
+        import jax.numpy as jnp
+
+        if self.momentum == 0.0:
+            return {"step": 0}
+        return {"step": 0,
+                "velocity": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state):
+        import jax
+
+        lr, mom, wd = self.lr, self.momentum, self.weight_decay
+
+        if mom == 0.0:
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * (g + wd * p), params, grads)
+            return new_params, {"step": state["step"] + 1}
+
+        def upd(p, g, v):
+            g = g + wd * p
+            v_new = mom * v + g
+            step = (g + mom * v_new) if self.nesterov else v_new
+            return p - lr * step, v_new
+
+        flat = jax.tree_util.tree_map(upd, params, grads, state["velocity"])
+        new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                            is_leaf=lambda t: isinstance(t, tuple))
+        new_vel = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                         is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"step": state["step"] + 1, "velocity": new_vel}
+
+
+class AdamOptimizer(Optimizer):
+    """reference: optimizer.h:77-96 (alpha, beta1, beta2, weight_decay,
+    epsilon; alpha_t bias-corrected schedule via ``next()``, optimizer.cc)."""
+
+    def __init__(self, ffmodel=None, alpha: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, weight_decay: float = 0.0,
+                 epsilon: float = 1e-8):
+        self.alpha = alpha
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.weight_decay = weight_decay
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        import jax
+        import jax.numpy as jnp
+
+        zeros = lambda p: jnp.zeros_like(p)
+        return {"step": 0,
+                "m": jax.tree_util.tree_map(zeros, params),
+                "v": jax.tree_util.tree_map(zeros, params)}
+
+    def update(self, params, grads, state):
+        import jax
+        import jax.numpy as jnp
+
+        step = state["step"] + 1
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
+        # bias-corrected alpha_t exactly as the reference's next() computes it
+        alpha_t = self.alpha * jnp.sqrt(1.0 - b2 ** step) / (1.0 - b1 ** step)
+
+        def upd(p, g, m, v):
+            g = g + wd * p
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            p_new = p - alpha_t * m_new / (jnp.sqrt(v_new) + eps)
+            return p_new, m_new, v_new
+
+        trip = jax.tree_util.tree_map(upd, params, grads, state["m"], state["v"])
+        is_leaf = lambda t: isinstance(t, tuple)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], trip, is_leaf=is_leaf)
+        new_m = jax.tree_util.tree_map(lambda t: t[1], trip, is_leaf=is_leaf)
+        new_v = jax.tree_util.tree_map(lambda t: t[2], trip, is_leaf=is_leaf)
+        return new_params, {"step": step, "m": new_m, "v": new_v}

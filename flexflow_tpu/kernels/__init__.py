@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the hot ops (SURVEY §7: attention, softmax, top-k,
+MoE dispatch)."""
+from .flash_attention import flash_attention  # noqa: F401

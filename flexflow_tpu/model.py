@@ -1,0 +1,675 @@
+"""FFModel: the central model object.
+
+TPU-native rebuild of the reference's ``FFModel`` (include/flexflow/model.h:326,
+src/runtime/model.cc:4708): the op-builder API (model.h:336-554, mirrored from
+the Python surface flexflow_cffi.py:883-2100 which is the compatibility
+contract), ``compile`` (model.cc:2803), and the train-step drivers
+(forward/backward/update/fit/eval).
+
+``compile`` here follows the same pipeline as the reference's (SURVEY §3.3):
+Layer graph -> PCG (`create_operators_from_layers`, model.cc:2785) -> strategy
+selection (Unity search / data-parallel default / imported strategy) -> lowering
+(Executor builds the jitted step; XLA replaces Legion mapping + regions).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import FFConfig
+from .ffconst import (ActiMode, AggrMode, CompMode, DataType, LossType,
+                      MetricsType, OperatorType, PoolType, dtype_to_jnp,
+                      jnp_to_dtype)
+from .layer import Layer
+from .tensor import Tensor
+from .execution.losses import loss_value
+from .execution.metrics import Metrics, PerfMetrics
+from .execution.optimizers import Optimizer, SGDOptimizer
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self._layers: List[Layer] = []
+        self._input_tensors: List[Tensor] = []
+        self.optimizer: Optional[Optimizer] = None
+
+        # populated by compile()
+        self.pcg = None
+        self.strategy = None
+        self.mesh = None
+        self.executor = None
+        self.params = None
+        self.opt_state = None
+        self.metrics_obj: Optional[Metrics] = None
+        self.loss_type: Optional[LossType] = None
+        self.label_tensor: Optional[Tensor] = None
+        self._perf = PerfMetrics()
+        self._tensor_to_node: Dict[int, int] = {}  # tensor.guid -> pcg guid/idx
+        self._layer_to_node: Dict[int, int] = {}
+        self._rng_counter = 0
+        # manual-loop staging (API parity: forward/backward/update phases)
+        self._staged: Dict[str, Any] = {}
+        self._recompile_state = None
+
+    # ======================================================= tensor creation ==
+    def create_tensor(self, dims: Sequence[int],
+                      dtype: DataType = DataType.DT_FLOAT,
+                      create_grad: bool = True, name: str = "") -> Tensor:
+        t = Tensor(dims, dtype, create_grad=create_grad,
+                   name=name or f"input_{len(self._input_tensors)}", model=self)
+        self._input_tensors.append(t)
+        return t
+
+    # ================================================================ builders ==
+    def _add_layer(self, op_type: OperatorType, inputs: List[Tensor],
+                   attrs: Dict[str, Any], dtype: Optional[DataType] = None,
+                   name: Optional[str] = None, num_outputs: int = 1
+                   ) -> Union[Tensor, List[Tensor]]:
+        from .ops.base import op_class_for
+
+        dtype = dtype or (inputs[0].dtype if inputs else DataType.DT_FLOAT)
+        layer = Layer(op_type, dtype, name, inputs, attrs=attrs)
+        op = op_class_for(op_type)(layer.name, attrs, dtype,
+                                   num_inputs=len(inputs))
+        out_shapes = op.infer_output_shapes([t.dims for t in inputs])
+        out_dtype = op.output_dtype([t.dtype for t in inputs])
+        # surface declared weights as user-visible tensors (reference parity)
+        for wname, (shape, wdtype, init) in op.weight_specs(
+                [t.dims for t in inputs]).items():
+            layer.add_weight(wname, shape, wdtype, init)
+        outs = []
+        for i, s in enumerate(out_shapes):
+            t = Tensor(s, out_dtype, owner_layer=layer, owner_idx=i, model=self)
+            t.name = f"{layer.name}:out{i}"
+            outs.append(t)
+        layer.outputs = outs
+        self._layers.append(layer)
+        return outs[0] if len(outs) == 1 else outs
+
+    # ---- dense / conv / pool (reference model.h:336-554) ----------------------
+    def dense(self, input: Tensor, out_dim: int,
+              activation: ActiMode = ActiMode.AC_MODE_NONE,
+              use_bias: bool = True, datatype: Optional[DataType] = None,
+              kernel_initializer=None, bias_initializer=None,
+              name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_LINEAR, [input],
+            {"out_dim": out_dim, "activation": activation, "use_bias": use_bias,
+             "kernel_initializer": kernel_initializer,
+             "bias_initializer": bias_initializer},
+            datatype or input.dtype, name)
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,
+               kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
+               padding_w: int, activation: ActiMode = ActiMode.AC_MODE_NONE,
+               groups: int = 1, use_bias: bool = True,
+               kernel_initializer=None, bias_initializer=None,
+               name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_CONV2D, [input],
+            {"out_channels": out_channels, "kernel_h": kernel_h,
+             "kernel_w": kernel_w, "stride_h": stride_h, "stride_w": stride_w,
+             "padding_h": padding_h, "padding_w": padding_w,
+             "activation": activation, "groups": groups, "use_bias": use_bias,
+             "kernel_initializer": kernel_initializer,
+             "bias_initializer": bias_initializer},
+            input.dtype, name)
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               pool_type: PoolType = PoolType.POOL_MAX,
+               activation: ActiMode = ActiMode.AC_MODE_NONE,
+               name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_POOL2D, [input],
+            {"kernel_h": kernel_h, "kernel_w": kernel_w, "stride_h": stride_h,
+             "stride_w": stride_w, "padding_h": padding_h,
+             "padding_w": padding_w, "pool_type": pool_type,
+             "activation": activation}, input.dtype, name)
+
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.OP_BATCHNORM, [input],
+                               {"relu": relu}, input.dtype, name)
+
+    def layer_norm(self, input: Tensor, axes: Sequence[int],
+                   elementwise_affine: bool = True, eps: float = 1e-5,
+                   name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_LAYERNORM, [input],
+            {"axes": list(axes), "elementwise_affine": elementwise_affine,
+             "eps": eps}, input.dtype, name)
+
+    def rms_norm(self, input: Tensor, axes: Sequence[int] = (-1,),
+                 eps: float = 1e-6, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.OP_RMSNORM, [input],
+                               {"axes": list(axes), "eps": eps},
+                               input.dtype, name)
+
+    def batch_matmul(self, A: Tensor, B: Tensor,
+                     name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.OP_BATCHMATMUL, [A, B], {},
+                               A.dtype, name)
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+                  dtype: DataType = DataType.DT_FLOAT, shared_op=None,
+                  kernel_initializer=None, name: Optional[str] = None
+                  ) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_EMBEDDING, [input],
+            {"num_entries": num_entries, "out_dim": out_dim, "aggr": aggr,
+             "kernel_initializer": kernel_initializer}, dtype, name)
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0,
+                            bias: bool = True, add_bias_kv: bool = False,
+                            add_zero_attn: bool = False,
+                            kernel_initializer=None, causal: bool = False,
+                            name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_MULTIHEAD_ATTENTION, [query, key, value],
+            {"embed_dim": embed_dim, "num_heads": num_heads, "kdim": kdim,
+             "vdim": vdim, "dropout": dropout, "bias": bias,
+             "add_bias_kv": add_bias_kv, "add_zero_attn": add_zero_attn,
+             "kernel_initializer": kernel_initializer, "causal": causal},
+            query.dtype, name)
+
+    # ---- elementwise ----------------------------------------------------------
+    def _binary(self, op_type, x, y, name=None, inplace_a=False):
+        return self._add_layer(op_type, [x, y], {}, x.dtype, name)
+
+    def add(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_ADD, x, y, name, inplace_a)
+
+    def subtract(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_SUB, x, y, name, inplace_a)
+
+    def multiply(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_MUL, x, y, name, inplace_a)
+
+    def divide(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_DIV, x, y, name, inplace_a)
+
+    def max(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_MAX, x, y, name, inplace_a)
+
+    def min(self, x, y, inplace_a=False, name=None):
+        return self._binary(OperatorType.OP_EW_MIN, x, y, name, inplace_a)
+
+    def _unary(self, op_type, x, attrs=None, name=None):
+        return self._add_layer(op_type, [x], attrs or {}, x.dtype, name)
+
+    def exp(self, x, name=None):
+        return self._unary(OperatorType.OP_EXP, x, name=name)
+
+    def log(self, x, name=None):
+        return self._unary(OperatorType.OP_LOG, x, name=name)
+
+    def sin(self, x, name=None):
+        return self._unary(OperatorType.OP_SIN, x, name=name)
+
+    def cos(self, x, name=None):
+        return self._unary(OperatorType.OP_COS, x, name=name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OperatorType.OP_RSQRT, x, name=name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OperatorType.OP_POW, x, {"exponent": exponent}, name)
+
+    def scalar_multiply(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OperatorType.OP_SCALAR_MULTIPLY, x,
+                           {"scalar": scalar}, name)
+
+    def scalar_add(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OperatorType.OP_SCALAR_ADD, x, {"scalar": scalar},
+                           name)
+
+    def scalar_sub(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OperatorType.OP_SCALAR_SUB, x, {"scalar": scalar},
+                           name)
+
+    def scalar_true_divide(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OperatorType.OP_SCALAR_TRUE_DIV, x,
+                           {"scalar": scalar}, name)
+
+    def relu(self, x, inplace=True, name=None):
+        return self._unary(OperatorType.OP_RELU, x, name=name)
+
+    def identity(self, x, name=None):
+        return self._unary(OperatorType.OP_IDENTITY, x, name=name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OperatorType.OP_SIGMOID, x, name=name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OperatorType.OP_TANH, x, name=name)
+
+    def elu(self, x, inplace=True, name=None):
+        return self._unary(OperatorType.OP_ELU, x, name=name)
+
+    def gelu(self, x, name=None):
+        return self._unary(OperatorType.OP_GELU, x, name=name)
+
+    def dropout(self, x, rate: float = 0.5, seed: int = 0, name=None):
+        return self._unary(OperatorType.OP_DROPOUT, x,
+                           {"rate": rate, "seed": seed}, name)
+
+    # ---- shape ops ------------------------------------------------------------
+    def flat(self, x, name=None):
+        return self._unary(OperatorType.OP_FLAT, x, name=name)
+
+    def softmax(self, x, axis: int = -1, name=None):
+        return self._unary(OperatorType.OP_SOFTMAX, x, {"axis": axis}, name)
+
+    def reshape(self, x, shape: Sequence[int], name=None):
+        return self._unary(OperatorType.OP_RESHAPE, x,
+                           {"shape": list(shape)}, name)
+
+    def transpose(self, x, perm: Sequence[int], name=None):
+        return self._unary(OperatorType.OP_TRANSPOSE, x,
+                           {"perm": list(perm)}, name)
+
+    def reverse(self, x, axis: int, name=None):
+        return self._unary(OperatorType.OP_REVERSE, x, {"axis": axis}, name)
+
+    def concat(self, tensors: List[Tensor], axis: int, name=None):
+        return self._add_layer(OperatorType.OP_CONCAT, list(tensors),
+                               {"axis": axis}, tensors[0].dtype, name)
+
+    def split(self, x, sizes: Union[int, List[int]], axis: int, name=None):
+        if isinstance(sizes, int):
+            dim = x.dims[axis % len(x.dims)]
+            assert dim % sizes == 0
+            sizes = [dim // sizes] * sizes
+        outs = self._add_layer(OperatorType.OP_SPLIT, [x],
+                               {"sizes": list(sizes), "axis": axis},
+                               x.dtype, name)
+        return outs if isinstance(outs, list) else [outs]
+
+    def gather(self, x, index: Tensor, dim: int, name=None):
+        return self._add_layer(OperatorType.OP_GATHER, [x, index],
+                               {"dim": dim}, x.dtype, name)
+
+    def cast(self, x, dtype: DataType, name=None):
+        return self._add_layer(OperatorType.OP_CAST, [x],
+                               {"target_dtype": dtype}, dtype, name)
+
+    def mean(self, x, dims: Sequence[int], keepdims: bool = False, name=None):
+        return self._unary(OperatorType.OP_MEAN, x,
+                           {"axes": list(dims), "keepdims": keepdims}, name)
+
+    def reduce_sum(self, x, axes: Sequence[int], keepdims: bool = False,
+                   name=None):
+        return self._unary(OperatorType.OP_REDUCE_SUM, x,
+                           {"axes": list(axes), "keepdims": keepdims}, name)
+
+    def top_k(self, x, k: int, sorted: bool = True, name=None):
+        return self._add_layer(OperatorType.OP_TOPK, [x],
+                               {"k": k, "sorted": sorted}, x.dtype, name)
+
+    # ---- MoE (reference: src/ops/moe.cc, group_by.cc, aggregate.cc) -----------
+    def group_by(self, input: Tensor, assign: Tensor, n: int,
+                 alpha: float = 1.0, name=None) -> List[Tensor]:
+        outs = self._add_layer(OperatorType.OP_GROUP_BY, [input, assign],
+                               {"n": n, "alpha": alpha}, input.dtype, name)
+        return outs if isinstance(outs, list) else [outs]
+
+    def aggregate(self, gate_preds: Tensor, gate_assign: Tensor,
+                  true_gate_assign: Tensor, full_gate_grads: Tensor,
+                  exp_preds: List[Tensor], n: int, lambda_bal: float = 0.0,
+                  name=None) -> Tensor:
+        ins = [gate_preds, gate_assign, true_gate_assign, full_gate_grads] + \
+            list(exp_preds)
+        return self._add_layer(OperatorType.OP_AGGREGATE, ins,
+                               {"n": n, "lambda_bal": lambda_bal},
+                               exp_preds[0].dtype, name)
+
+    def aggregate_spec(self, gate_preds, gate_assign, true_gate_assign,
+                       full_gate_grads, exp_preds: List[Tensor], n: int,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        ins = [gate_preds, gate_assign, true_gate_assign, full_gate_grads] + \
+            list(exp_preds)
+        return self._add_layer(OperatorType.OP_AGG_SPEC, ins,
+                               {"n": n, "lambda_bal": lambda_bal},
+                               exp_preds[0].dtype, name)
+
+    def cache(self, input: Tensor, num_batches: int, score_fn=None, name=None):
+        return self._unary(OperatorType.OP_CACHE, input,
+                           {"num_batches": num_batches, "score_fn": score_fn},
+                           name)
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int,
+            expert_hidden_size: int, alpha: float = 2.0,
+            lambda_bal: float = 0.04) -> Tensor:
+        """Composite MoE layer (reference: FFModel::moe, src/ops/moe.cc:20-45):
+        gate dense -> softmax -> top_k -> group_by -> per-expert dense ->
+        aggregate."""
+        gate = self.dense(input, num_exp, name="moe_gate")
+        gate = self.softmax(gate)
+        topk_out = self.top_k(gate, num_select)
+        topk_values, topk_assign = topk_out[0], topk_out[1]
+        grouped = self.group_by(input, topk_assign, num_exp, alpha)
+        exp_preds = [
+            self.dense(g, expert_hidden_size,
+                       activation=ActiMode.AC_MODE_RELU,
+                       name=f"moe_expert_{i}")
+            for i, g in enumerate(grouped)
+        ]
+        return self.aggregate(topk_values, topk_assign, topk_assign, gate,
+                              exp_preds, num_exp, lambda_bal)
+
+    # ============================================================== compile ==
+    def compile(self, optimizer: Optional[Optimizer] = None,
+                loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Optional[List[MetricsType]] = None,
+                comp_mode: CompMode = CompMode.COMP_MODE_TRAINING) -> None:
+        """Lower the Layer graph to a PCG, pick a strategy, build the executor
+        (reference pipeline: src/runtime/model.cc:2803, SURVEY §3.3)."""
+        from .execution.executor import Executor
+        from .parallel.mesh import build_mesh
+        from .parallel.pcg import PCG
+        from .parallel.strategy import Strategy, data_parallel_strategy
+        from .ops.base import op_class_for
+
+        if optimizer is not None:
+            self.optimizer = optimizer
+        if self.optimizer is None:
+            self.optimizer = SGDOptimizer(self)
+        self.loss_type = loss_type
+        self.metrics_obj = Metrics(loss_type, metrics or [])
+
+        # -- create_operators_from_layers (model.cc:2785) -----------------------
+        pcg = PCG()
+        tensor_to_out: Dict[int, Tuple[int, int]] = {}
+        for t in self._input_tensors:
+            node = pcg.add_node(
+                op_class_for(OperatorType.OP_INPUT)(
+                    t.name, {"shape": t.dims, "dtype": t.dtype}, t.dtype, 0),
+                [])
+            tensor_to_out[t.guid] = (node.guid, 0)
+            self._tensor_to_node[t.guid] = node.guid
+        for layer in self._layers:
+            op = op_class_for(layer.op_type)(
+                layer.name, layer.attrs, layer.data_type,
+                num_inputs=len(layer.inputs))
+            inputs = [tensor_to_out[t.guid] for t in layer.inputs]
+            node = pcg.add_node(op, inputs)
+            self._layer_to_node[layer.guid] = node.guid
+            for i, t in enumerate(layer.outputs):
+                tensor_to_out[t.guid] = (node.guid, i)
+                self._tensor_to_node[t.guid] = node.guid
+        self.pcg = pcg
+
+        # final op = last compute node (the reference uses the graph's sink)
+        sinks = [n for n in pcg.sinks()
+                 if n.op.op_type != OperatorType.OP_INPUT]
+        final = sinks[-1]
+        self.final_guid = final.guid
+        repl_labels = final.op.op_type == OperatorType.OP_AGG_SPEC
+
+        # -- mesh + strategy ----------------------------------------------------
+        import jax
+
+        devices = jax.devices()
+        n_dev = len(devices)
+        if self.config.import_strategy_file:
+            with open(self.config.import_strategy_file) as f:
+                self.strategy = Strategy.from_json(f.read(), pcg)
+            self.mesh = build_mesh(self.config,
+                                   mesh_shape=self.strategy.mesh_shape,
+                                   axis_names=self.strategy.axis_names)
+        elif self.config.only_data_parallel or n_dev == 1:
+            if self.config.mesh_shape:
+                # honor an explicit user mesh: batch shards over the first axis
+                self.mesh = build_mesh(self.config)
+                axes = tuple(self.mesh.axis_names)
+                self.strategy = data_parallel_strategy(
+                    pcg, int(self.mesh.shape[axes[0]]), axis_names=axes)
+            else:
+                self.strategy = data_parallel_strategy(pcg, n_dev)
+                self.mesh = build_mesh(self.config, mesh_shape=(n_dev,),
+                                       axis_names=("data",))
+        else:
+            # Unity search (SURVEY §7 stage 5); falls back to DP if the
+            # search finds nothing better
+            self.strategy = self._run_search(pcg, n_dev)
+            self.mesh = build_mesh(self.config,
+                                   mesh_shape=self.strategy.mesh_shape,
+                                   axis_names=self.strategy.axis_names)
+
+        if self.config.export_strategy_file:
+            with open(self.config.export_strategy_file, "w") as f:
+                f.write(self.strategy.to_json(pcg))
+        if self.config.export_strategy_computation_graph_file:
+            with open(self.config.export_strategy_computation_graph_file,
+                      "w") as f:
+                f.write(pcg.to_dot(
+                    include_costs=self.config.include_costs_dot_graph))
+
+        # -- label tensor (model.cc:3090-3124) ----------------------------------
+        out_shape = final.out_shapes[0]
+        if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            label_shape = (out_shape[0], 1)
+            label_dtype = DataType.DT_INT32
+        else:
+            label_shape = out_shape
+            label_dtype = final.out_dtypes[0]
+        self.label_tensor = Tensor(label_shape, label_dtype, name="label",
+                                   model=self)
+
+        self.executor = Executor(pcg, self.mesh, self.strategy, loss_type,
+                                 self.metrics_obj, self.optimizer, self.config,
+                                 self.final_guid, label_dtype, repl_labels)
+        self.params = self.executor.init_params(self.config.numpy_seed())
+        self.opt_state = self.optimizer.init_state(self.params)
+
+    def _run_search(self, pcg, n_dev):
+        from .parallel.strategy import data_parallel_strategy
+
+        try:
+            from .search.unity import unity_search
+
+            return unity_search(pcg, self.config, n_dev)
+        except ImportError:
+            return data_parallel_strategy(pcg, n_dev)
+
+    # ============================================================ training ==
+    def _next_rng(self):
+        import jax
+
+        self._rng_counter += 1
+        return jax.random.PRNGKey(
+            self.config.numpy_seed() * 100003 + self._rng_counter)
+
+    def _as_input_list(self, x) -> List[np.ndarray]:
+        if isinstance(x, (list, tuple)):
+            return [np.asarray(a) for a in x]
+        return [np.asarray(x)]
+
+    def _prep_label(self, y: np.ndarray) -> np.ndarray:
+        y = np.asarray(y)
+        if self.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            y = y.reshape(y.shape[0], 1).astype(np.int32)
+        return y
+
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, callbacks=None) -> PerfMetrics:
+        """Training loop (reference: flexflow_cffi.py:2058-2100 — per batch:
+        next_batch -> forward -> zero_gradients -> backward -> update inside a
+        Legion trace; here one fused jitted step per batch)."""
+        import jax
+
+        assert self.executor is not None, "call compile() first"
+        xs = self._as_input_list(x)
+        y = self._prep_label(y)
+        batch_size = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        step_fn = self.executor.make_train_step()
+        from .data.dataloader import batch_iterator, prefetch_iterator
+
+        in_shardings = [self.executor.batch_sharding(a.ndim) for a in xs]
+        label_sharding = self.executor.batch_sharding(y.ndim)
+
+        self._perf = PerfMetrics()
+        num_samples = xs[0].shape[0]
+        steps_per_epoch = num_samples // batch_size
+        t0 = time.time()
+        step_count = 0
+        loss_val = None
+        for epoch in range(epochs):
+            it = batch_iterator(xs + [y], batch_size, shuffle=False)
+            epoch_metrics = []  # device-side; folded at epoch end (async)
+            for batch in prefetch_iterator(
+                    it, in_shardings + [label_sharding]):
+                bx, by = batch[:-1], batch[-1]
+                self.params, self.opt_state, loss_val, m = step_fn(
+                    self.params, self.opt_state, bx, by, self._next_rng())
+                epoch_metrics.append(m)
+                step_count += 1
+                if self.config.profiling and \
+                        step_count % max(self.config.print_freq, 1) == 0:
+                    print(f"step {step_count}: loss={float(loss_val):.4f}")
+            for m in epoch_metrics:
+                self._perf.update({k: np.asarray(v) for k, v in m.items()})
+            if self.config.profiling:
+                print(f"epoch {epoch}: loss={float(loss_val):.4f}")
+        if loss_val is not None:
+            jax.block_until_ready(loss_val)
+        elapsed = time.time() - t0
+        self._last_fit_time = elapsed
+        self._last_fit_samples = steps_per_epoch * batch_size * epochs
+        if self.config.profiling and elapsed > 0:
+            print(f"THROUGHPUT = {self._last_fit_samples / elapsed:.2f} "
+                  f"samples/s")
+        return self._perf
+
+    def eval(self, x=None, y=None, batch_size: Optional[int] = None
+             ) -> PerfMetrics:
+        """reference: flexflow_cffi.py:2102."""
+        xs = self._as_input_list(x)
+        y = self._prep_label(y)
+        batch_size = batch_size or self.config.batch_size
+        estep = self.executor.make_eval_step()
+        from .data.dataloader import batch_iterator
+
+        perf = PerfMetrics()
+        for batch in batch_iterator(xs + [y], batch_size,
+                                    drop_remainder=False):
+            bx, by = batch[:-1], batch[-1]
+            loss_val, m = estep(self.params, bx, by)
+            perf.update({k: np.asarray(v) for k, v in m.items()})
+        return perf
+
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        xs = self._as_input_list(x)
+        batch_size = batch_size or self.config.batch_size
+        fwd = self.executor.make_forward()
+        from .data.dataloader import batch_iterator
+
+        outs = []
+        for batch in batch_iterator(xs, batch_size, drop_remainder=False):
+            outs.append(np.asarray(fwd(self.params, batch)))
+        return np.concatenate(outs, axis=0)
+
+    # ---- manual-loop API parity (model.cc:2415-2469) --------------------------
+    def init_operators(self) -> None:
+        pass  # op state is created lazily by jit; kept for API parity
+
+    def forward(self, seq_length: Optional[int] = None) -> None:
+        assert self._staged.get("batch") is not None, \
+            "bind a batch first via next_batch/set_batch"
+        fwd = self.executor.make_forward()
+        xs, _ = self._staged["batch"]
+        self._staged["logits"] = fwd(self.params, xs)
+
+    def zero_gradients(self) -> None:
+        self._staged.pop("grads", None)
+
+    def backward(self, seq_length: Optional[int] = None) -> None:
+        import jax
+
+        xs, y = self._staged["batch"]
+
+        from .ops.base import OpContext
+
+        def loss_fn(params):
+            fwdvals = self.executor.forward_outputs(
+                params, self.executor._bind_inputs(xs),
+                OpContext(training=True, rng=self._next_rng(), mesh=self.mesh))
+            logits = fwdvals[self.final_guid][0]
+            return loss_value(self.loss_type, logits, y,
+                              self.executor.repl_labels)
+
+        self._staged["loss"], self._staged["grads"] = jax.value_and_grad(
+            loss_fn)(self.params)
+
+    def update(self) -> None:
+        grads = self._staged.get("grads")
+        assert grads is not None, "call backward() first"
+        self.params, self.opt_state = self.optimizer.update(
+            self.params, grads, self.opt_state)
+
+    def set_batch(self, x, y) -> None:
+        import jax
+
+        xs = [jax.device_put(np.asarray(a)) for a in self._as_input_list(x)]
+        self._staged["batch"] = (xs, jax.device_put(self._prep_label(y)))
+
+    # ---- recompilation (reference: RecompileState, model.cc:2422) -------------
+    def recompile_on_condition(self, recompile_state) -> bool:
+        if recompile_state.trigger():
+            recompile_state.alter(self)
+            return True
+        return False
+
+    # ================================================== weights / dataloaders ==
+    def create_data_loader(self, batch_tensor: Tensor, full_array: np.ndarray):
+        from .data.dataloader import SingleDataLoader
+
+        return SingleDataLoader(self, batch_tensor, full_array)
+
+    def _locate_weight(self, tensor: Tensor) -> Tuple[str, str]:
+        layer = tensor.owner_layer
+        assert layer is not None and tensor.owner_idx < 0, \
+            f"{tensor.name} is not a weight tensor"
+        wname = tensor.name.split(".")[-1]
+        return layer.name, wname
+
+    def _get_weight_by_tensor(self, tensor: Tensor) -> np.ndarray:
+        node_name, wname = self._locate_weight(tensor)
+        return np.asarray(self.params[node_name][wname])
+
+    def _set_weight_by_tensor(self, tensor: Tensor, arr: np.ndarray) -> None:
+        import jax
+
+        node_name, wname = self._locate_weight(tensor)
+        cur = self.params[node_name][wname]
+        arr = np.asarray(arr, dtype=np.asarray(cur).dtype)
+        assert arr.shape == cur.shape, (arr.shape, cur.shape)
+        self.params[node_name][wname] = jax.device_put(
+            arr, cur.sharding if hasattr(cur, "sharding") else None)
+
+    # ================================================================= misc ==
+    def get_layers(self) -> Dict[int, Layer]:
+        return {i: l for i, l in enumerate(self._layers)}
+
+    def get_layer_by_id(self, layer_id: int) -> Layer:
+        return self._layers[layer_id]
+
+    def get_layer_by_name(self, name: str) -> Optional[Layer]:
+        for l in self._layers:
+            if l.name == name:
+                return l
+        return None
+
+    def get_perf_metrics(self) -> PerfMetrics:
+        return self._perf
+
+    def __repr__(self) -> str:
+        return f"FFModel({len(self._layers)} layers)"

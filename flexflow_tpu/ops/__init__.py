@@ -1,0 +1,12 @@
+"""Operator library: every compute op of the reference's src/ops/ inventory
+(SURVEY §2.2) as a jax-traceable Op subclass, registered by OperatorType."""
+from .base import Op, OpContext, op_class_for, register_op  # noqa: F401
+from . import linear  # noqa: F401
+from . import conv  # noqa: F401
+from . import elementwise  # noqa: F401
+from . import normalization  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import attention  # noqa: F401
+from . import embedding  # noqa: F401
+from . import moe_ops  # noqa: F401
+from . import noop  # noqa: F401

@@ -1,0 +1,144 @@
+"""Multi-head attention.
+
+Reference: src/ops/attention.cc (926 LoC) using cuDNN's packed
+``cudnnMultiHeadAttnForward`` (attention.cu:35-128). TPU-native: separate
+q/k/v/o projections (MXU matmuls) + scaled-dot-product core. The core runs
+either as plain einsums (XLA fuses + tiles) or the Pallas flash-attention
+kernel (kernels/flash_attention.py) for long sequences — selected at lowering
+time, not by the user.
+
+Parallelism: shardable over batch (sample) and heads (the reference's
+attribute parallelism, substitution.cc:3169 create_partition_attention_combine)
+by sharding the head dim of the projection weights; sequence parallelism /
+ring attention is provided by the RING_ATTENTION variant (parallel extension,
+absent in the reference — SURVEY §5 long-context).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+
+def mha_core(q, k, v, *, causal: bool = False, dropout: float = 0.0,
+             rng=None, training: bool = False):
+    """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim)."""
+    import jax
+    import jax.numpy as jnp
+
+    head_dim = q.shape[-1]
+    scale = 1.0 / np.sqrt(head_dim)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if training and dropout > 0.0 and rng is not None:
+        keep = 1.0 - dropout
+        probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+@register_op(OperatorType.OP_MULTIHEAD_ATTENTION)
+class MultiHeadAttentionOp(Op):
+    """attrs: embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
+    add_zero_attn, causal, use_flash (builder: FFModel::multihead_attention,
+    reference model.h:520-537).
+
+    inputs: (query, key, value), each (batch, seq, dim).
+    output: (batch, seq_q, embed_dim).
+    """
+
+    def _dims(self):
+        a = self.attrs
+        embed = a["embed_dim"]
+        heads = a["num_heads"]
+        kdim = a.get("kdim") or embed // heads
+        vdim = a.get("vdim") or embed // heads
+        return embed, heads, kdim, vdim
+
+    def infer_output_shapes(self, input_shapes):
+        q = input_shapes[0]
+        return [(q[0], q[1], self.attrs["embed_dim"])]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import (DefaultBiasInitializer,
+                                              DefaultWeightInitializer)
+
+        embed, heads, kdim, vdim = self._dims()
+        q_in = input_shapes[0][-1]
+        k_in = input_shapes[1][-1]
+        v_in = input_shapes[2][-1]
+        init = self.attrs.get("kernel_initializer") or DefaultWeightInitializer()
+        specs = {
+            "wq": ((q_in, heads, kdim), self.data_type, init),
+            "wk": ((k_in, heads, kdim), self.data_type, init),
+            "wv": ((v_in, heads, vdim), self.data_type, init),
+            "wo": ((heads, vdim, embed), self.data_type, init),
+        }
+        if self.attrs.get("bias", True):
+            specs["bo"] = ((embed,), self.data_type, DefaultBiasInitializer())
+        return specs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        q_in, k_in, v_in = inputs
+        q = jnp.einsum("bsd,dhk->bhsk", q_in, params["wq"])
+        k = jnp.einsum("bsd,dhk->bhsk", k_in, params["wk"])
+        v = jnp.einsum("bsd,dhk->bhsk", v_in, params["wv"])
+        use_flash = self.attrs.get("use_flash", "auto")
+        causal = self.attrs.get("causal", False)
+        if _should_use_flash(use_flash, q):
+            from ..kernels.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal)
+        else:
+            out = mha_core(q, k, v, causal=causal,
+                           dropout=self.attrs.get("dropout", 0.0),
+                           rng=ctx.rng, training=ctx.training)
+        y = jnp.einsum("bhsv,hvd->bsd", out, params["wo"],
+                       preferred_element_type=jnp.float32).astype(q_in.dtype)
+        if "bo" in params:
+            y = y + params["bo"]
+        return [y]
+
+    def flops(self, input_shapes, output_shapes):
+        b, sq, _ = input_shapes[0]
+        sk = input_shapes[1][1]
+        embed, heads, kdim, vdim = self._dims()
+        proj = 2 * b * sq * input_shapes[0][-1] * heads * kdim * 3 \
+            + 2 * b * sq * heads * vdim * embed
+        core = 2 * b * heads * sq * sk * (kdim + vdim)
+        return proj + core
+
+    def parallelizable_dims(self, input_shapes):
+        return {
+            "batch": True,
+            # head (attribute) parallelism: shard heads dim of all projections
+            "heads": {"weights": {"wq": 1, "wk": 1, "wv": 1, "wo": 0},
+                      "reduces_output": True},
+        }
+
+
+def _should_use_flash(use_flash, q) -> bool:
+    if use_flash is True:
+        return True
+    if use_flash == "auto":
+        import jax
+
+        try:
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            on_tpu = False
+        # flash pays off for long seq; block size needs seq % 128 == 0
+        return on_tpu and q.shape[-2] >= 1024 and q.shape[-2] % 128 == 0 \
+            and q.shape[-1] % 128 == 0
+    return False

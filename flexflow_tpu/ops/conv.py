@@ -1,0 +1,170 @@
+"""Conv2D, Pool2D, Flat, BatchNorm.
+
+Reference: src/ops/conv_2d.cc (1198 LoC, cuDNN), pool_2d.cc, flat.cc,
+batch_norm.cc. User-visible layout is NCHW to match the reference API
+(FFModel::conv2d, model.h); internally XLA picks the TPU-friendly layout, and
+kernels are stored HWIO which is what lax.conv_general_dilated wants.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..ffconst import ActiMode, DataType, OperatorType, PoolType
+from .base import Op, OpContext, register_op
+from .linear import apply_activation
+
+
+def _conv_out(size: int, kernel: int, stride: int, pad: int) -> int:
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register_op(OperatorType.OP_CONV2D)
+class Conv2DOp(Op):
+    """attrs: out_channels, kernel_h/w, stride_h/w, padding_h/w, activation,
+    groups, use_bias (reference builder: FFModel::conv2d, src/ops/conv_2d.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        n, c, h, w = input_shapes[0]
+        a = self.attrs
+        oh = _conv_out(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        ow = _conv_out(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return [(n, a["out_channels"], oh, ow)]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import (DefaultBiasInitializer,
+                                              DefaultWeightInitializer)
+
+        a = self.attrs
+        in_c = input_shapes[0][1] // a.get("groups", 1)
+        specs = {
+            "kernel": ((a["kernel_h"], a["kernel_w"], in_c, a["out_channels"]),
+                       self.data_type,
+                       a.get("kernel_initializer") or DefaultWeightInitializer()),
+        }
+        if a.get("use_bias", True):
+            specs["bias"] = ((a["out_channels"],), self.data_type,
+                             a.get("bias_initializer") or DefaultBiasInitializer())
+        return specs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.lax as lax
+
+        (x,) = inputs
+        a = self.attrs
+        y = lax.conv_general_dilated(
+            x, params["kernel"],
+            window_strides=(a["stride_h"], a["stride_w"]),
+            padding=((a["padding_h"], a["padding_h"]),
+                     (a["padding_w"], a["padding_w"])),
+            dimension_numbers=("NCHW", "HWIO", "NCHW"),
+            feature_group_count=a.get("groups", 1),
+            preferred_element_type=np.float32,
+        ).astype(x.dtype)
+        if "bias" in params:
+            y = y + params["bias"][None, :, None, None]
+        return [apply_activation(y, a.get("activation", ActiMode.AC_MODE_NONE))]
+
+    def flops(self, input_shapes, output_shapes):
+        a = self.attrs
+        n, co, oh, ow = output_shapes[0]
+        ci = input_shapes[0][1] // a.get("groups", 1)
+        return 2 * n * co * oh * ow * ci * a["kernel_h"] * a["kernel_w"]
+
+    def parallelizable_dims(self, input_shapes):
+        return {
+            "batch": True,
+            "channel_out": {"output_dim": 1, "weights": {"kernel": 3, "bias": 0}},
+            # attribute (spatial) parallelism of the reference's
+            # create_mapping_xfers<Conv2D> (substitution.cc:1797) maps to
+            # sharding H: only valid for 1x1-pad-free convs; search checks.
+        }
+
+
+@register_op(OperatorType.OP_POOL2D)
+class Pool2DOp(Op):
+    """attrs: kernel_h/w, stride_h/w, padding_h/w, pool_type, activation
+    (reference: src/ops/pool_2d.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        n, c, h, w = input_shapes[0]
+        a = self.attrs
+        oh = _conv_out(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        ow = _conv_out(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return [(n, c, oh, ow)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        a = self.attrs
+        window = (1, 1, a["kernel_h"], a["kernel_w"])
+        strides = (1, 1, a["stride_h"], a["stride_w"])
+        pads = ((0, 0), (0, 0), (a["padding_h"], a["padding_h"]),
+                (a["padding_w"], a["padding_w"]))
+        if a.get("pool_type", PoolType.POOL_MAX) == PoolType.POOL_MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        else:
+            ones = jnp.ones_like(x)
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+            y = s / cnt
+        return [apply_activation(y, a.get("activation", ActiMode.AC_MODE_NONE))]
+
+
+@register_op(OperatorType.OP_FLAT)
+class FlatOp(Op):
+    """Flatten all non-batch dims (reference: src/ops/flat.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        return [(s[0], int(np.prod(s[1:])))]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        (x,) = inputs
+        return [x.reshape(x.shape[0], -1)]
+
+    def can_inplace_output(self):
+        return True
+
+
+@register_op(OperatorType.OP_BATCHNORM)
+class BatchNormOp(Op):
+    """attrs: relu, momentum, eps (reference: src/ops/batch_norm.cc, cuDNN).
+
+    Running statistics are non-trainable params updated functionally: forward
+    returns the output; the executor threads running stats as mutable state.
+    For parity with the reference (which only tracks stats for inference) the
+    training path uses batch statistics.
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import ConstantInitializer, ZeroInitializer
+
+        c = input_shapes[0][1]
+        return {
+            "scale": ((c,), self.data_type, ConstantInitializer(1.0)),
+            "bias": ((c,), self.data_type, ZeroInitializer()),
+        }
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        eps = self.attrs.get("eps", 1e-5)
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        scale = params["scale"].reshape((1, -1) + (1,) * (x.ndim - 2))
+        bias = params["bias"].reshape((1, -1) + (1,) * (x.ndim - 2))
+        y = (x - mean) * scale / jnp.sqrt(var + eps) + bias
+        if self.attrs.get("relu", True):
+            import jax.nn as jnn
+
+            y = jnn.relu(y)
+        return [y]

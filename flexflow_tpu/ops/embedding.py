@@ -1,0 +1,66 @@
+"""Embedding lookup with sum/avg/none aggregation.
+
+Reference: src/ops/embedding.cc (1205 LoC) + kernels/embedding_kernels.cu —
+DLRM's key op, table-sharded for parameter parallelism. TPU-native: jnp.take
+(XLA gather, which the SPMD partitioner turns into a sharded gather +
+collective when the table dim is sharded over the mesh).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ffconst import AggrMode, DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+
+@register_op(OperatorType.OP_EMBEDDING)
+class EmbeddingOp(Op):
+    """attrs: num_entries, out_dim, aggr (AggrMode), kernel_initializer.
+
+    input: int ids of shape (batch,) or (batch, bag); output:
+    (batch, out_dim) for SUM/AVG aggregation over the bag dim, or
+    (batch, bag, out_dim) for AGGR_MODE_NONE (reference: embedding.cc,
+    AggrMode at ffconst.h:18).
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        aggr = self.attrs.get("aggr", AggrMode.AGGR_MODE_NONE)
+        if aggr == AggrMode.AGGR_MODE_NONE:
+            return [tuple(s) + (self.attrs["out_dim"],)]
+        return [(s[0], self.attrs["out_dim"])]
+
+    def output_dtype(self, input_dtypes):
+        return self.data_type
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import NormInitializer
+
+        return {
+            "weight": ((self.attrs["num_entries"], self.attrs["out_dim"]),
+                       self.data_type,
+                       self.attrs.get("kernel_initializer") or NormInitializer(
+                           stddev=0.05)),
+        }
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (ids,) = inputs
+        table = params["weight"]
+        out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+        aggr = self.attrs.get("aggr", AggrMode.AGGR_MODE_NONE)
+        if aggr == AggrMode.AGGR_MODE_SUM:
+            out = jnp.sum(out, axis=1)
+        elif aggr == AggrMode.AGGR_MODE_AVG:
+            out = jnp.mean(out, axis=1)
+        return [out]
+
+    def parallelizable_dims(self, input_shapes):
+        return {
+            "batch": True,
+            # table (parameter) parallelism: shard the vocab dim of the weight;
+            # XLA handles the masked-gather + psum (reference: DLRM strategies)
+            "channel_out": {"output_dim": -1, "weights": {"weight": 1}},
+            "table": {"weights": {"weight": 0}, "reduces_output": True},
+        }

@@ -1,0 +1,93 @@
+"""Linear (dense) operator — the canonical op (reference: src/ops/linear.cc:1184,
+kernels src/ops/kernels/linear_kernels.cu).
+
+TPU-native: a single jnp.dot that XLA tiles onto the MXU, with the activation
+fused by XLA (the reference fuses via cuBLAS epilogue / cuDNN activation).
+Weight layout is (in_dim, out_dim) so row/column tensor-parallelism is a
+sharding of one weight dim:
+
+* column-parallel = shard ``out_dim`` (reference: replicate-linear-combine xfer,
+  substitution.cc:3226) — output is sharded, no collective.
+* row-parallel = shard ``in_dim`` (reference: partition-linear-combine,
+  substitution.cc:3041) — output needs a psum, inserted by XLA when the
+  contraction dim is sharded.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..ffconst import ActiMode, DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+
+def apply_activation(x, activation: ActiMode):
+    import jax.numpy as jnp
+    import jax.nn as jnn
+
+    if activation == ActiMode.AC_MODE_NONE:
+        return x
+    if activation == ActiMode.AC_MODE_RELU:
+        return jnn.relu(x)
+    if activation == ActiMode.AC_MODE_SIGMOID:
+        return jnn.sigmoid(x)
+    if activation == ActiMode.AC_MODE_TANH:
+        return jnp.tanh(x)
+    if activation == ActiMode.AC_MODE_GELU:
+        return jnn.gelu(x)
+    raise ValueError(f"unknown activation {activation}")
+
+
+@register_op(OperatorType.OP_LINEAR)
+class LinearOp(Op):
+    """attrs: out_dim, activation, use_bias, kernel_initializer, bias_initializer."""
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        return [tuple(ishape[:-1]) + (self.attrs["out_dim"],)]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import (DefaultBiasInitializer,
+                                              DefaultWeightInitializer)
+
+        in_dim = input_shapes[0][-1]
+        out_dim = self.attrs["out_dim"]
+        specs = {
+            "kernel": ((in_dim, out_dim), self.data_type,
+                       self.attrs.get("kernel_initializer")
+                       or DefaultWeightInitializer()),
+        }
+        if self.attrs.get("use_bias", True):
+            specs["bias"] = ((out_dim,), self.data_type,
+                             self.attrs.get("bias_initializer")
+                             or DefaultBiasInitializer())
+        return specs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        kernel = params["kernel"]
+        y = jnp.dot(x, kernel, preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype)
+        if "bias" in params:
+            y = y + params["bias"]
+        return [apply_activation(y, self.attrs.get("activation",
+                                                   ActiMode.AC_MODE_NONE))]
+
+    def flops(self, input_shapes, output_shapes):
+        ishape = input_shapes[0]
+        return 2 * int(np.prod(ishape)) * self.attrs["out_dim"]
+
+    def parallelizable_dims(self, input_shapes):
+        ndim = len(input_shapes[0])
+        return {
+            "batch": True,
+            # shard out_dim (column-parallel): kernel dim 1, bias dim 0
+            "channel_out": {"output_dim": ndim - 1,
+                            "weights": {"kernel": 1, "bias": 0}},
+            # shard in_dim (row-parallel): kernel dim 0; output unreduced -> psum
+            "channel_in": {"input_dim": ndim - 1, "weights": {"kernel": 0},
+                           "reduces_output": True},
+        }

@@ -1,0 +1,173 @@
+"""Mixture-of-Experts building blocks: GroupBy, Aggregate, AggregateSpec, Cache.
+
+Reference: src/ops/group_by.cc (534 LoC, ragged scatter with capacity factor
+``alpha``), aggregate.cc (569, gate-weighted gather + load-balance loss term
+``lambda_bal``), aggregate_spec.cc (519, speculative variant), cache.cc (291).
+
+TPU-native design (SURVEY §7 hard-part 4): the reference's dynamic ragged
+routing becomes **fixed-capacity dense dispatch** — a one-hot dispatch tensor
+computed from the assignments, contracted on the MXU (the Switch/GShard
+recipe). Capacity = ceil(k * batch * alpha / n), matching the reference's
+definition of its per-expert buffer. Overflowing tokens are dropped exactly as
+the reference drops them when the buffer fills. Both GroupBy and Aggregate
+recompute the same deterministic dispatch from ``assign`` so they stay
+consistent without carrying ragged state.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+
+def moe_capacity(k: int, batch: int, alpha: float, n: int) -> int:
+    return int(np.ceil(k * batch * alpha / n))
+
+
+def dispatch_mask(assign, n: int, capacity: int):
+    """assign: (tokens,) int in [0, n) -> (tokens, n, capacity) one-hot dispatch.
+
+    Token priority is index order (the reference packs in scan order,
+    group_by.cu). Tokens past an expert's capacity get an all-zero row (drop).
+    """
+    import jax.numpy as jnp
+    import jax.nn as jnn
+
+    expert_onehot = jnn.one_hot(assign, n, dtype=jnp.int32)  # (t, n)
+    pos = jnp.cumsum(expert_onehot, axis=0) * expert_onehot - 1  # (t, n)
+    pos_clipped = jnp.clip(pos, 0, capacity - 1)
+    keep = (pos >= 0) & (pos < capacity)
+    slot = jnn.one_hot(pos_clipped, capacity, dtype=jnp.int32)  # (t, n, cap)
+    return slot * keep[..., None]  # (t, n, cap) in {0,1}
+
+
+@register_op(OperatorType.OP_GROUP_BY)
+class GroupByOp(Op):
+    """attrs: n (num experts), alpha (capacity factor).
+
+    inputs: (input (batch, d), assign (batch, k) int)
+    outputs: n tensors of (capacity, d) — reference: FFModel::group_by,
+    src/ops/group_by.cc.
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        (batch, d), (_, k) = input_shapes
+        n = self.attrs["n"]
+        cap = moe_capacity(k, batch, self.attrs.get("alpha", 1.0), n)
+        return [(cap, d)] * n
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        x, assign = inputs
+        batch, d = x.shape
+        k = assign.shape[1]
+        n = self.attrs["n"]
+        cap = moe_capacity(k, batch, self.attrs.get("alpha", 1.0), n)
+        assign_flat = assign.reshape(-1).astype(jnp.int32)  # (batch*k,)
+        x_flat = jnp.repeat(x, k, axis=0)  # token order matches assign_flat
+        disp = dispatch_mask(assign_flat, n, cap).astype(x.dtype)  # (t, n, c)
+        grouped = jnp.einsum("td,tnc->ncd", x_flat, disp,
+                             preferred_element_type=jnp.float32).astype(x.dtype)
+        return [grouped[e] for e in range(n)]
+
+    def parallelizable_dims(self, input_shapes):
+        # expert parallelism: each output (expert buffer) placeable on its own
+        # submesh (reference: per-expert MachineViews) -> shard the expert dim
+        return {"batch": False, "expert": True}
+
+
+@register_op(OperatorType.OP_AGGREGATE)
+class AggregateOp(Op):
+    """attrs: n, lambda_bal.
+
+    inputs: (gate_preds (batch, k), gate_assign (batch, k),
+             true_gate_assign (batch, k), full_gate_grads (batch, n),
+             exp_pred_0..exp_pred_{n-1} each (capacity, d))
+    output: (batch, d) — reference: src/ops/aggregate.cc. The load-balance
+    term flows through autodiff via the gate contraction (the reference
+    hand-codes it in aggregate.cu's backward).
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        batch = input_shapes[0][0]
+        d = input_shapes[4][1]
+        return [(batch, d)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+        import jax.nn as jnn
+
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        exp_preds = jnp.stack(inputs[4:], axis=0)  # (n, cap, d)
+        batch, k = gate_assign.shape
+        n = self.attrs["n"]
+        cap = exp_preds.shape[1]
+        assign_flat = gate_assign.reshape(-1).astype(jnp.int32)
+        disp = dispatch_mask(assign_flat, n, cap)  # (t, n, c)
+        combine = disp.astype(gate_preds.dtype) * gate_preds.reshape(-1)[:, None, None]
+        out_flat = jnp.einsum("tnc,ncd->td", combine, exp_preds,
+                              preferred_element_type=jnp.float32)
+        out = out_flat.reshape(batch, k, -1).sum(axis=1)
+        # load-balance auxiliary loss (reference: lambda_bal term applied in
+        # aggregate.cu's backward): n * sum_e(load_e * importance_e), the
+        # Switch/GShard differentiable surrogate. full_gate_grads = gate
+        # probabilities over all n experts (batch, n).
+        lambda_bal = self.attrs.get("lambda_bal", 0.0)
+        if lambda_bal and ctx.training and ctx.aux_losses is not None:
+            full_gate = inputs[3].astype(jnp.float32)  # (batch, n)
+            load = jnp.mean(
+                jnn.one_hot(gate_assign[:, 0].astype(jnp.int32), n,
+                            dtype=jnp.float32), axis=0)  # top-1 token fraction
+            importance = jnp.mean(full_gate, axis=0)
+            ctx.aux_losses.append(lambda_bal * n * jnp.sum(load * importance))
+        return [out.astype(exp_preds.dtype)]
+
+
+@register_op(OperatorType.OP_AGG_SPEC)
+class AggregateSpecOp(Op):
+    """Speculative aggregation: one output row per (token, assignment) so the
+    loss supervises every expert's prediction; labels are replicated k times by
+    compile (reference: aggregate_spec.cc; model.cc:2875-2877).
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        batch, k = input_shapes[1]
+        d = input_shapes[4][1]
+        return [(batch * k, d)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        gate_assign = inputs[1]
+        exp_preds = jnp.stack(inputs[4:], axis=0)
+        batch, k = gate_assign.shape
+        n = self.attrs["n"]
+        cap = exp_preds.shape[1]
+        assign_flat = gate_assign.reshape(-1).astype(jnp.int32)
+        disp = dispatch_mask(assign_flat, n, cap).astype(exp_preds.dtype)
+        out = jnp.einsum("tnc,ncd->td", disp, exp_preds,
+                         preferred_element_type=jnp.float32)
+        return [out.astype(exp_preds.dtype)]
+
+
+@register_op(OperatorType.OP_CACHE)
+class CacheOp(Op):
+    """Caches an intermediate tensor across iterations, re-using it while a
+    user score function deems it fresh (reference: src/ops/cache.cc:291; pairs
+    with dynamic recompile, recompile.h). Functionally: the executor threads a
+    ``cache_state`` aux pytree; forward selects cached vs fresh value.
+
+    attrs: num_batches, score_fn (callable(cached, fresh) -> float, host-side).
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        # Cache state handling lives in the executor (aux-state pytree); inside
+        # the pure graph the op is identity on its input.
+        return [inputs[0]]

@@ -1,0 +1,40 @@
+"""NoOp / Input / Weight placeholder nodes of the PCG.
+
+Reference: src/ops/noop.cc:255 — OP_INPUT/OP_WEIGHT/OP_NOOP nodes anchor graph
+sources so the search can treat inputs/weights uniformly.
+"""
+from __future__ import annotations
+
+from ..ffconst import OperatorType
+from .base import Op, OpContext, register_op
+
+
+@register_op(OperatorType.OP_NOOP)
+class NoOp(Op):
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+
+@register_op(OperatorType.OP_INPUT)
+class InputOp(Op):
+    """Graph source; attrs: shape, dtype."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [tuple(self.attrs["shape"])]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        raise RuntimeError("InputOp is bound by the executor, never executed")
+
+
+@register_op(OperatorType.OP_WEIGHT)
+class WeightOp(Op):
+    """Weight source node; attrs: shape, dtype."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [tuple(self.attrs["shape"])]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        raise RuntimeError("WeightOp is bound by the executor, never executed")

@@ -1,0 +1,98 @@
+"""LayerNorm, RMSNorm, Softmax.
+
+Reference: src/ops/layer_norm.cc (601 LoC, custom kernels), softmax.cc (cuDNN).
+RMSNorm is a TPU-native extension (no reference analog; standard for LLM
+parity). XLA fuses these; a Pallas fused-softmax lives in kernels/ for the
+attention path.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..ffconst import OperatorType
+from .base import Op, OpContext, register_op
+
+
+@register_op(OperatorType.OP_LAYERNORM)
+class LayerNormOp(Op):
+    """attrs: axes (list of ints), elementwise_affine, eps
+    (reference builder: FFModel::layer_norm, src/ops/layer_norm.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def _norm_shape(self, ishape):
+        axes = [a % len(ishape) for a in self.attrs.get("axes", [len(ishape) - 1])]
+        return tuple(ishape[a] for a in sorted(axes))
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import ConstantInitializer, ZeroInitializer
+
+        if not self.attrs.get("elementwise_affine", True):
+            return {}
+        nshape = self._norm_shape(input_shapes[0])
+        return {
+            "scale": (nshape, self.data_type, ConstantInitializer(1.0)),
+            "bias": (nshape, self.data_type, ZeroInitializer()),
+        }
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        ndim = x.ndim
+        axes = tuple(sorted(a % ndim for a in self.attrs.get("axes", [ndim - 1])))
+        eps = self.attrs.get("eps", 1e-5)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) / jnp.sqrt(var + eps)
+        if "scale" in params:
+            bshape = [x.shape[a] if a in axes else 1 for a in range(ndim)]
+            y = y * params["scale"].reshape(bshape) + params["bias"].reshape(bshape)
+        return [y.astype(x.dtype)]
+
+
+@register_op(OperatorType.OP_RMSNORM)
+class RMSNormOp(Op):
+    """attrs: axes, eps. TPU-native extension for LLM blocks."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import ConstantInitializer
+
+        ishape = input_shapes[0]
+        axes = [a % len(ishape) for a in self.attrs.get("axes", [len(ishape) - 1])]
+        nshape = tuple(ishape[a] for a in sorted(axes))
+        return {"scale": (nshape, self.data_type, ConstantInitializer(1.0))}
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        ndim = x.ndim
+        axes = tuple(sorted(a % ndim for a in self.attrs.get("axes", [ndim - 1])))
+        eps = self.attrs.get("eps", 1e-6)
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+        y = xf / jnp.sqrt(ms + eps)
+        bshape = [x.shape[a] if a in axes else 1 for a in range(ndim)]
+        return [(y * params["scale"].reshape(bshape)).astype(x.dtype)]
+
+
+@register_op(OperatorType.OP_SOFTMAX)
+class SoftmaxOp(Op):
+    """attrs: axis (reference: src/ops/softmax.cc; -1 default like FFModel::softmax)."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.nn as jnn
+
+        (x,) = inputs
+        return [jnn.softmax(x, axis=self.attrs.get("axis", -1))]

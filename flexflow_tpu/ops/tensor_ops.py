@@ -1,0 +1,203 @@
+"""Shape/data-movement ops: reshape, transpose, reverse, concat, split, gather,
+reduce, mean, topk, batch_matmul.
+
+Reference: src/ops/{reshape,transpose,reverse,concat,split,gather,reduce,mean,
+topk,batch_matmul}.cc. All are single XLA HLO ops here; top-k keeps a custom
+Pallas path (kernels/topk.py) for the MoE hot loop.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+
+@register_op(OperatorType.OP_RESHAPE)
+class ReshapeOp(Op):
+    """attrs: shape (new shape, batch included; -1 allowed once)."""
+
+    def infer_output_shapes(self, input_shapes):
+        target = list(self.attrs["shape"])
+        vol = int(np.prod(input_shapes[0]))
+        if -1 in target:
+            i = target.index(-1)
+            rest = int(np.prod([t for t in target if t != -1]))
+            target[i] = vol // rest
+        assert int(np.prod(target)) == vol, (input_shapes, target)
+        return [tuple(target)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        out_shape = self.infer_output_shapes([inputs[0].shape])[0]
+        return [inputs[0].reshape(out_shape)]
+
+    def can_inplace_output(self):
+        return True
+
+
+@register_op(OperatorType.OP_TRANSPOSE)
+class TransposeOp(Op):
+    """attrs: perm (full permutation, reference: src/ops/transpose.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        return [tuple(s[p] for p in self.attrs["perm"])]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        return [jnp.transpose(inputs[0], self.attrs["perm"])]
+
+
+@register_op(OperatorType.OP_REVERSE)
+class ReverseOp(Op):
+    """attrs: axis."""
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        return [jnp.flip(inputs[0], axis=self.attrs["axis"])]
+
+
+@register_op(OperatorType.OP_CONCAT)
+class ConcatOp(Op):
+    """attrs: axis; variadic inputs (reference: src/ops/concat.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        axis = self.attrs["axis"] % len(input_shapes[0])
+        out = list(input_shapes[0])
+        out[axis] = sum(s[axis] for s in input_shapes)
+        return [tuple(out)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        return [jnp.concatenate(inputs, axis=self.attrs["axis"])]
+
+
+@register_op(OperatorType.OP_SPLIT)
+class SplitOp(Op):
+    """attrs: sizes (list), axis (reference: src/ops/split.cc)."""
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        axis = self.attrs["axis"] % len(s)
+        outs = []
+        for sz in self.attrs["sizes"]:
+            o = list(s)
+            o[axis] = sz
+            outs.append(tuple(o))
+        return outs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        axis = self.attrs["axis"] % x.ndim
+        offsets = np.cumsum(self.attrs["sizes"])[:-1].tolist()
+        return list(jnp.split(x, offsets, axis=axis))
+
+
+@register_op(OperatorType.OP_GATHER)
+class GatherOp(Op):
+    """torch.gather semantics (reference: src/ops/gather.cc:440).
+
+    inputs: (input, index); attrs: dim. output shape == index shape.
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[1]]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        x, idx = inputs
+        dim = self.attrs["dim"] % x.ndim
+        return [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=dim)]
+
+
+@register_op(OperatorType.OP_REDUCE_SUM)
+class ReduceSumOp(Op):
+    """attrs: axes, keepdims (reference: src/ops/reduce.cc)."""
+
+    def _axes(self, ndim):
+        return tuple(sorted(a % ndim for a in self.attrs["axes"]))
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        axes = self._axes(len(s))
+        keep = self.attrs.get("keepdims", False)
+        out = [(1 if keep else None) if i in axes else d for i, d in enumerate(s)]
+        return [tuple(d for d in out if d is not None)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        return [jnp.sum(x, axis=self._axes(x.ndim),
+                        keepdims=self.attrs.get("keepdims", False))]
+
+
+@register_op(OperatorType.OP_REDUCE_MEAN)
+class ReduceMeanOp(ReduceSumOp):
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        return [jnp.mean(x, axis=self._axes(x.ndim),
+                         keepdims=self.attrs.get("keepdims", False))]
+
+
+@register_op(OperatorType.OP_MEAN)
+class MeanOp(ReduceMeanOp):
+    """reference: src/ops/mean.cc."""
+
+
+@register_op(OperatorType.OP_TOPK)
+class TopKOp(Op):
+    """attrs: k, sorted. outputs: (values, indices) over last dim
+    (reference: src/ops/topk.cc:437, custom GPU kernel — here lax.top_k,
+    with a Pallas variant in kernels/topk.py for MoE routing)."""
+
+    def infer_output_shapes(self, input_shapes):
+        s = input_shapes[0]
+        out = tuple(s[:-1]) + (self.attrs["k"],)
+        return [out, out]
+
+    def output_dtypes(self, input_dtypes, num_outputs):
+        return [input_dtypes[0], DataType.DT_INT32]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.lax as lax
+
+        (x,) = inputs
+        values, indices = lax.top_k(x, self.attrs["k"])
+        return [values, indices]
+
+
+@register_op(OperatorType.OP_BATCHMATMUL)
+class BatchMatmulOp(Op):
+    """(b, m, k) x (b, k, n) -> (b, m, n)
+    (reference: src/ops/batch_matmul.cc, cuBLAS strided-batched)."""
+
+    def infer_output_shapes(self, input_shapes):
+        a, b = input_shapes
+        assert a[-1] == b[-2], (a, b)
+        return [tuple(a[:-1]) + (b[-1],)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        a, b = inputs
+        y = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        return [y.astype(a.dtype)]
+
+    def flops(self, input_shapes, output_shapes):
+        a = input_shapes[0]
+        n = output_shapes[0][-1]
+        return 2 * int(np.prod(a)) * n

@@ -1,0 +1,4 @@
+from .pcg import PCG, PCGNode  # noqa: F401
+from .strategy import Strategy, NodeStrategy, data_parallel_strategy  # noqa: F401
+from .mesh import build_mesh  # noqa: F401
+from . import parallel_op  # noqa: F401

@@ -1,0 +1,53 @@
+"""Device mesh construction.
+
+Replaces the reference's machine model + FFMapper placement (src/mapper/) with
+a ``jax.sharding.Mesh``. The reference's MachineView device grids become
+shardings over named mesh axes; start_device_id offsets are not representable
+under whole-program SPMD (SURVEY §7 hard-part 1) and are absorbed into axis
+assignment.
+
+Axis convention: ``data`` (batch/sample parallel), ``model`` (tensor/attribute
+parallel), optional ``expert`` and ``seq`` axes for EP/SP strategies.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def build_mesh(config=None, mesh_shape: Optional[Sequence[int]] = None,
+               axis_names: Optional[Sequence[str]] = None,
+               devices=None):
+    """Build the global Mesh.
+
+    Defaults to a 1-D data-parallel mesh over all visible devices (the
+    reference's default DataParallelism strategy, config.h:95-100).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None and config is not None:
+        mesh_shape = config.mesh_shape
+    if axis_names is None:
+        axis_names = (config.mesh_axis_names if config is not None
+                      else ("data", "model"))
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (n, 1) if len(axis_names) == 2 else (n,) + (1,) * (
+            len(axis_names) - 1)
+    mesh_shape = tuple(int(s) for s in mesh_shape)
+    total = int(np.prod(mesh_shape))
+    assert total <= n, f"mesh {mesh_shape} needs {total} devices, have {n}"
+    axis_names = tuple(axis_names)[:len(mesh_shape)]
+    if len(axis_names) < len(mesh_shape):
+        axis_names = axis_names + tuple(
+            f"ax{i}" for i in range(len(axis_names), len(mesh_shape)))
+    dev_array = np.asarray(devices[:total]).reshape(mesh_shape)
+    return Mesh(dev_array, axis_names)
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1

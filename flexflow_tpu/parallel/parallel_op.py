@@ -1,0 +1,132 @@
+"""Parallel operators — the parallelism vocabulary of the PCG.
+
+Reference: src/parallel_ops/ — Repartition/Combine/Replicate/Reduction/
+FusedParallelOp are first-class graph nodes inserted by the search; each
+realizes data movement via a Legion partition + copy kernel
+(e.g. combine_kernels.cu:27, reduction_kernels.cu:24-34).
+
+TPU-native lowering (SURVEY §7): a parallel op is a **resharding node**. Under
+``jax.jit`` + SPMD it emits ``lax.with_sharding_constraint`` with the op's
+target sharding; XLA's partitioner then materializes the minimal collective
+(all-gather for Combine, slice/all-to-all for Repartition, broadcast for
+Replicate, reduce-scatter/psum for Reduction) over ICI — replacing the
+reference's hand-built partitions. The nodes stay first-class so the Unity
+search can insert/remove/fuse them and cost their communication exactly like
+the reference does.
+
+attrs (all): ``dim`` (tensor dim), ``degree``, ``axes`` (mesh axes involved).
+The node's target ParallelTensorShape is attached by the strategy assignment
+(``target_pts``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..ffconst import OperatorType
+from ..ops.base import Op, OpContext, register_op
+from ..parallel_tensor import ParallelTensorShape
+
+
+class ParallelOpBase(Op):
+    """Common base (reference: include/flexflow/parallel_ops/parallel_op.h)."""
+
+    is_parallel_op = True
+
+    def __init__(self, name, attrs, dtype, num_inputs=1):
+        super().__init__(name, attrs, dtype, num_inputs)
+        self.target_pts: Optional[ParallelTensorShape] = None
+
+    def infer_output_shapes(self, input_shapes):
+        # parallel ops never change the *global* logical shape
+        return [input_shapes[0]]
+
+    def _constrain(self, x, ctx: OpContext):
+        if ctx.mesh is None or self.target_pts is None:
+            return x
+        import jax.lax as lax
+        from jax.sharding import NamedSharding
+
+        spec = self.target_pts.partition_spec()
+        return lax.with_sharding_constraint(x, NamedSharding(ctx.mesh, spec))
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [self._constrain(inputs[0], ctx)]
+
+    # comm-volume hook for the simulator: bytes moved per device
+    def comm_bytes(self, input_shape, dtype_size: int, num_devices: int) -> int:
+        raise NotImplementedError
+
+
+@register_op(OperatorType.OP_REPARTITION)
+class RepartitionOp(ParallelOpBase):
+    """Split dim ``dim`` into ``degree`` parts (reference: partition.cc).
+    Fwd comm: resharding scatter; XLA emits slice or all-to-all."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        # worst case: every element moves once
+        return int(np.prod(input_shape)) * dtype_size // max(num_devices, 1)
+
+
+@register_op(OperatorType.OP_COMBINE)
+class CombineOp(ParallelOpBase):
+    """Merge shards of dim ``dim`` back, degree /= k (reference: combine.cc).
+    Fwd comm: all-gather of the dim."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        deg = self.attrs.get("degree", 1)
+        return int(np.prod(input_shape)) * dtype_size * (deg - 1) // max(deg, 1)
+
+
+@register_op(OperatorType.OP_REPLICATE)
+class ReplicateOp(ParallelOpBase):
+    """Add/grow a replica dim — broadcast fwd, grad-sum bwd
+    (reference: replicate.cc). XLA: broadcast on fwd, psum in autodiff."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        deg = self.attrs.get("degree", 1)
+        return int(np.prod(input_shape)) * dtype_size * (deg - 1) // max(deg, 1)
+
+
+@register_op(OperatorType.OP_REDUCTION)
+class ReductionOp(ParallelOpBase):
+    """Sum over a replica dim, e.g. after a row-parallel linear
+    (reference: reduction.cc). XLA: reduce-scatter/psum emitted when the
+    contraction dim was sharded; the node pins the reduced output sharding."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        deg = self.attrs.get("degree", 1)
+        return int(np.prod(input_shape)) * dtype_size * (deg - 1) // max(deg, 1)
+
+
+@register_op(OperatorType.OP_FUSED_PARALLEL)
+class FusedParallelOp(ParallelOpBase):
+    """A pipeline of parallel ops collapsed into one resharding
+    (reference: fused_parallel_op.cc; built by fuse_parallel_ops,
+    graph.h:285-290). attrs: ``ops`` = list of (OperatorType, dim, degree).
+    Under XLA one with_sharding_constraint to the final sharding subsumes the
+    chain — exactly the fusion the reference implements by hand."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        return int(np.prod(input_shape)) * dtype_size
+
+
+@register_op(OperatorType.OP_ALLTOALL)
+class AllToAllOp(ParallelOpBase):
+    """TPU-native extension: explicit all-to-all resharding for expert/sequence
+    parallelism (no reference analog; OP_PIPELINE-style enum slot). Swaps the
+    sharded dim: attrs ``src_dim`` -> ``dst_dim``."""
+
+    def comm_bytes(self, input_shape, dtype_size, num_devices):
+        import numpy as np
+
+        return int(np.prod(input_shape)) * dtype_size

@@ -1,0 +1,130 @@
+"""Parallel Computation Graph (PCG).
+
+Analog of the reference's search-time ``PCG::Graph`` (include/flexflow/graph.h:293,
+src/runtime/graph.cc:2753): a graph of (Op, guid) nodes over edges carrying
+tensor indices. The same structure serves (a) lowering to a jax function,
+(b) the Unity search (which mutates copies of it thousands of times — hence
+cheap structural hashing, reference Graph::hash), and (c) strategy
+(de)serialization.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..ffconst import DataType, OperatorType
+from ..machine_view import MachineView
+from ..ops.base import Op
+
+_node_guid = itertools.count(1)
+
+
+@dataclasses.dataclass
+class PCGNode:
+    guid: int
+    op: Op
+    # each input is (producer_guid, producer_output_idx)
+    inputs: List[Tuple[int, int]]
+    out_shapes: List[Tuple[int, ...]] = dataclasses.field(default_factory=list)
+    out_dtypes: List[DataType] = dataclasses.field(default_factory=list)
+    machine_view: Optional[MachineView] = None
+
+    @property
+    def name(self) -> str:
+        return self.op.name
+
+
+class PCG:
+    """Node/edge container with topo order and structural hash."""
+
+    def __init__(self):
+        self.nodes: Dict[int, PCGNode] = {}
+        self._order: List[int] = []  # insertion == topological order
+
+    # -- construction -----------------------------------------------------------
+    def add_node(self, op: Op, inputs: Sequence[Tuple[int, int]]) -> PCGNode:
+        guid = next(_node_guid)
+        in_shapes = [self.nodes[g].out_shapes[i] for g, i in inputs]
+        in_dtypes = [self.nodes[g].out_dtypes[i] for g, i in inputs]
+        node = PCGNode(guid=guid, op=op, inputs=list(inputs))
+        if op.op_type in (OperatorType.OP_INPUT, OperatorType.OP_WEIGHT):
+            node.out_shapes = [tuple(op.attrs["shape"])]
+            node.out_dtypes = [op.attrs.get("dtype", DataType.DT_FLOAT)]
+        else:
+            node.out_shapes = [tuple(s) for s in op.infer_output_shapes(in_shapes)]
+            node.out_dtypes = op.output_dtypes(in_dtypes, len(node.out_shapes))
+        self.nodes[guid] = node
+        self._order.append(guid)
+        return node
+
+    # -- queries ----------------------------------------------------------------
+    def topo_order(self) -> List[PCGNode]:
+        return [self.nodes[g] for g in self._order]
+
+    def in_edges(self, guid: int) -> List[Tuple[int, int]]:
+        return self.nodes[guid].inputs
+
+    def consumers(self, guid: int) -> List[int]:
+        return [n.guid for n in self.nodes.values()
+                if any(g == guid for g, _ in n.inputs)]
+
+    def sources(self) -> List[PCGNode]:
+        return [n for n in self.topo_order() if not n.inputs]
+
+    def sinks(self) -> List[PCGNode]:
+        consumed = {g for n in self.nodes.values() for g, _ in n.inputs}
+        return [n for n in self.topo_order() if n.guid not in consumed]
+
+    def input_nodes(self) -> List[PCGNode]:
+        return [n for n in self.topo_order()
+                if n.op.op_type == OperatorType.OP_INPUT]
+
+    def weight_nodes(self) -> List[PCGNode]:
+        return [n for n in self.topo_order()
+                if n.op.op_type == OperatorType.OP_WEIGHT]
+
+    def compute_nodes(self) -> List[PCGNode]:
+        return [n for n in self.topo_order()
+                if n.op.op_type not in (OperatorType.OP_INPUT,
+                                        OperatorType.OP_WEIGHT)]
+
+    # -- structural hash (reference: Graph::hash) -------------------------------
+    def hash(self) -> int:
+        h = 17
+        remap = {g: i for i, g in enumerate(self._order)}
+        for g in self._order:
+            n = self.nodes[g]
+            key = (n.op.params_key(),
+                   tuple((remap[pg], pi) for pg, pi in n.inputs),
+                   n.machine_view.hash() if n.machine_view else 0)
+            h = hash((h, key))
+        return h
+
+    def copy(self) -> "PCG":
+        import copy as _copy
+
+        g = PCG()
+        g.nodes = {k: dataclasses.replace(
+            v, inputs=list(v.inputs), out_shapes=list(v.out_shapes),
+            out_dtypes=list(v.out_dtypes)) for k, v in self.nodes.items()}
+        g._order = list(self._order)
+        return g
+
+    # -- observability (reference: export_strategy_computation_graph) -----------
+    def to_dot(self, include_costs: bool = False, costs=None) -> str:
+        lines = ["digraph PCG {"]
+        for n in self.topo_order():
+            label = f"{n.name}\\n{n.op.op_type.name}"
+            if n.machine_view:
+                label += f"\\nview={n.machine_view.dim}"
+            if include_costs and costs and n.guid in costs:
+                label += f"\\ncost={costs[n.guid]:.1f}us"
+            lines.append(f'  n{n.guid} [label="{label}"];')
+            for pg, pi in n.inputs:
+                lines.append(f"  n{pg} -> n{n.guid} [label=\"{pi}\"];")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def __len__(self) -> int:
+        return len(self.nodes)

@@ -1,0 +1,107 @@
+"""Parallelization strategy: per-op sharding assignments over the mesh.
+
+This is the TPU-native form of the reference's search output — the map
+``op -> MachineView`` (``optimal_views``, graph.cc:2163-2320) plus the
+parallel-op placements. A ``Strategy`` assigns every PCG node:
+
+* ``view``: a MachineView (kept for parity/serialization),
+* per-weight PartitionSpec entries,
+* an optional output sharding constraint (what parallel ops pin).
+
+Strategies serialize to JSON for ``--export-strategy`` / ``--import-strategy``
+(reference: config.h:143-144, README.md:84-86).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..ffconst import OperatorType
+from ..machine_view import MachineView
+from .pcg import PCG, PCGNode
+
+# A spec entry is None or a mesh-axis name or tuple of names, one per tensor dim
+SpecT = Tuple[Optional[Any], ...]
+
+
+@dataclasses.dataclass
+class NodeStrategy:
+    view: MachineView = dataclasses.field(
+        default_factory=lambda: MachineView(dim=(1,)))
+    weight_specs: Dict[str, SpecT] = dataclasses.field(default_factory=dict)
+    output_spec: Optional[SpecT] = None  # constraint on output 0
+
+
+@dataclasses.dataclass
+class Strategy:
+    mesh_shape: Tuple[int, ...]
+    axis_names: Tuple[str, ...]
+    node_strategies: Dict[int, NodeStrategy] = dataclasses.field(
+        default_factory=dict)
+    # input batch sharding axis (the data-parallel dim)
+    data_axis: str = "data"
+
+    def for_node(self, guid: int) -> NodeStrategy:
+        return self.node_strategies.setdefault(guid, NodeStrategy())
+
+    # -- serialization (reference: export_strategy_file) ------------------------
+    def to_json(self, pcg: PCG) -> str:
+        out = {
+            "mesh_shape": list(self.mesh_shape),
+            "axis_names": list(self.axis_names),
+            "data_axis": self.data_axis,
+            "nodes": {},
+        }
+        for guid, ns in self.node_strategies.items():
+            if guid not in pcg.nodes:
+                continue
+            name = pcg.nodes[guid].name
+            out["nodes"][name] = {
+                "view": {"dim": list(ns.view.dim),
+                         "stride": list(ns.view.stride),
+                         "start": ns.view.start_device_id},
+                "weight_specs": {k: list(v) for k, v in ns.weight_specs.items()},
+                "output_spec": list(ns.output_spec) if ns.output_spec else None,
+            }
+        return json.dumps(out, indent=2)
+
+    @staticmethod
+    def from_json(text: str, pcg: PCG) -> "Strategy":
+        d = json.loads(text)
+        s = Strategy(mesh_shape=tuple(d["mesh_shape"]),
+                     axis_names=tuple(d["axis_names"]),
+                     data_axis=d.get("data_axis", "data"))
+        by_name = {n.name: n.guid for n in pcg.topo_order()}
+        for name, nd in d["nodes"].items():
+            if name not in by_name:
+                continue
+            v = nd["view"]
+            ns = NodeStrategy(
+                view=MachineView(dim=tuple(v["dim"]), stride=tuple(v["stride"]),
+                                 start_device_id=v.get("start", 0)),
+                weight_specs={k: _despec(x) for k, x in
+                              nd.get("weight_specs", {}).items()},
+                output_spec=_despec(nd["output_spec"])
+                if nd.get("output_spec") else None)
+            s.node_strategies[by_name[name]] = ns
+        return s
+
+
+def _despec(entries):
+    return tuple(tuple(e) if isinstance(e, list) else e for e in entries)
+
+
+def data_parallel_strategy(pcg: PCG, num_devices: int,
+                           axis_names: Sequence[str] = ("data",),
+                           ) -> Strategy:
+    """The reference's default DataParallelism strategy (config.h:95-100,
+    mapper.cc:414-427): batch dim sharded over all devices, weights replicated.
+    """
+    s = Strategy(mesh_shape=(num_devices,), axis_names=tuple(axis_names)[:1],
+                 data_axis=tuple(axis_names)[0])
+    view = MachineView.data_parallel(num_devices)
+    for node in pcg.topo_order():
+        ns = s.for_node(node.guid)
+        ns.view = view
+    return s

@@ -1,0 +1,136 @@
+"""Parallel (sharded) tensor metadata.
+
+Analog of the reference's ``ParallelDim`` / ``ParallelTensorShape`` /
+``ParallelTensorBase`` (include/flexflow/parallel_tensor.h:36-126). Each tensor
+dim carries ``{size, degree, is_replica_dim}`` exactly as in the reference, plus
+the TPU-native realization: the tuple of **mesh axis names** the dim is sharded
+over. A replica dim's "size" is its replication degree; at lowering time replica
+dims vanish from the array shape — their mesh axes simply do not appear in the
+PartitionSpec, which makes the tensor replicated over them (or, for gradients,
+unreduced — the distinction drives psum insertion, reference:
+Reduction/Replicate parallel-op semantics, src/parallel_ops/).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from .ffconst import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One dim of a ParallelTensorShape (reference: parallel_tensor.h:36-70)."""
+
+    size: int  # global extent (for replica dims: the replication degree)
+    degree: int = 1  # number of shards along this dim
+    parallel_idx: int = -1  # kept for strategy-serialization parity
+    is_replica_dim: bool = False
+    mesh_axes: Tuple[str, ...] = ()  # mesh axes realizing the sharding
+
+    def __post_init__(self):
+        object.__setattr__(self, "mesh_axes", tuple(self.mesh_axes))
+        if self.is_replica_dim:
+            assert self.degree == self.size, "replica dim degree == size"
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.degree > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Sharded shape (reference: parallel_tensor.h:76)."""
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.DT_FLOAT
+
+    def __post_init__(self):
+        object.__setattr__(self, "dims", tuple(self.dims))
+
+    # -- constructors -----------------------------------------------------------
+    @staticmethod
+    def unsharded(shape: Sequence[int], dtype: DataType = DataType.DT_FLOAT
+                  ) -> "ParallelTensorShape":
+        return ParallelTensorShape(
+            tuple(ParallelDim(size=int(s)) for s in shape), dtype)
+
+    # -- views ------------------------------------------------------------------
+    @property
+    def array_dims(self) -> Tuple[ParallelDim, ...]:
+        """Dims that exist in the materialized array (replica dims dropped)."""
+        return tuple(d for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def array_shape(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.array_dims)
+
+    @property
+    def replica_dims(self) -> Tuple[ParallelDim, ...]:
+        return tuple(d for d in self.dims if d.is_replica_dim)
+
+    @property
+    def num_replica_axes(self) -> Tuple[str, ...]:
+        axes: Tuple[str, ...] = ()
+        for d in self.replica_dims:
+            axes += d.mesh_axes
+        return axes
+
+    def total_degree(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d.degree
+        return n
+
+    def get_piece_shape(self) -> Tuple[int, ...]:
+        """Per-shard extent of the materialized array."""
+        return tuple(d.size // max(d.degree, 1) for d in self.array_dims)
+
+    def get_piece_num_elements(self) -> int:
+        n = 1
+        for s in self.get_piece_shape():
+            n *= s
+        return n
+
+    def num_elements(self) -> int:
+        n = 1
+        for s in self.array_shape:
+            n *= s
+        return n
+
+    # -- lowering to jax.sharding ----------------------------------------------
+    def partition_spec(self):
+        """NamedSharding PartitionSpec over the materialized dims.
+
+        Mesh axes attached to replica dims are intentionally absent from the
+        spec: XLA then replicates over them (the Replicate parallel-op
+        semantics, reference src/parallel_ops/replicate.cc).
+        """
+        from jax.sharding import PartitionSpec
+
+        entries = []
+        for d in self.array_dims:
+            if not d.mesh_axes:
+                entries.append(None)
+            elif len(d.mesh_axes) == 1:
+                entries.append(d.mesh_axes[0])
+            else:
+                entries.append(tuple(d.mesh_axes))
+        # trim trailing Nones for canonical form
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def with_dim_sharded(self, dim_idx: int, axes: Tuple[str, ...], degree: int
+                         ) -> "ParallelTensorShape":
+        dims = list(self.dims)
+        d = dims[dim_idx]
+        dims[dim_idx] = dataclasses.replace(d, degree=degree, mesh_axes=axes)
+        return ParallelTensorShape(tuple(dims), self.dtype)
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            tag = "R" if d.is_replica_dim else ""
+            parts.append(f"{d.size}{tag}/{d.degree}{list(d.mesh_axes)}")
+        return f"PTS[{', '.join(parts)}:{self.dtype.name}]"

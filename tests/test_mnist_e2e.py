@@ -1,0 +1,96 @@
+"""Minimum end-to-end slice (SURVEY §7 stage 2): MNIST-style MLP
+(dense/relu/softmax + SCCE + SGD) via ffmodel.fit — mirrors the reference's
+examples/python/native/mnist_mlp.py. Uses synthetic data (the reference's
+universal fixture, README.md:73)."""
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType, SGDOptimizer, ActiMode, DataType)
+
+
+def _make_data(n=256, d=64, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    # learnable synthetic task: class = argmax of a fixed linear map
+    w = rng.normal(size=(d, classes))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def test_mlp_fit_learns():
+    config = FFConfig()
+    config.batch_size = 32
+    config.epochs = 5
+    ff = FFModel(config)
+    x_t = ff.create_tensor((32, 64))
+    t = ff.dense(x_t, 128, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    t = ff.softmax(t)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY,
+                        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    x, y = _make_data()
+    ff.fit(x, y)
+    perf = ff.eval(x, y)
+    assert perf.accuracy() > 0.8, f"accuracy {perf.accuracy()}"
+
+
+def test_mse_regression():
+    config = FFConfig()
+    config.batch_size = 32
+    config.epochs = 40
+    ff = FFModel(config)
+    x_t = ff.create_tensor((32, 8))
+    t = ff.dense(x_t, 16, ActiMode.AC_MODE_TANH)
+    t = ff.dense(t, 1)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    ff.fit(x, y)
+    perf = ff.eval(x, y)
+    assert perf.mean("mse_loss") < 0.1
+
+
+def test_manual_loop_parity():
+    """forward/zero_gradients/backward/update as separate phases
+    (reference: flexflow_cffi.py:2086-2100)."""
+    config = FFConfig()
+    config.batch_size = 16
+    ff = FFModel(config)
+    x_t = ff.create_tensor((16, 8))
+    t = ff.dense(x_t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    ff.set_batch(x, y)
+    ff.forward()
+    ff.zero_gradients()
+    ff.backward()
+    loss_before = float(ff._staged["loss"])
+    ff.update()
+    ff.backward()
+    loss_after = float(ff._staged["loss"])
+    assert loss_after < loss_before
+
+
+def test_weight_get_set():
+    config = FFConfig()
+    ff = FFModel(config)
+    x_t = ff.create_tensor((4, 8))
+    t = ff.dense(x_t, 4, name="d1")
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    layer = ff.get_layer_by_id(0)
+    w = layer.get_parameter_by_id(0)
+    arr = w.get_weights(ff)
+    assert arr.shape == (8, 4)
+    new = np.ones_like(arr)
+    w.set_weights(ff, new)
+    assert np.allclose(w.get_weights(ff), 1.0)

@@ -1,0 +1,52 @@
+"""MoE composite layer e2e (reference: examples/cpp/mixture_of_experts/moe.cc)
+with the load-balance aux loss flowing through training."""
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType, ActiMode)
+
+
+def test_moe_trains():
+    config = FFConfig()
+    config.batch_size = 32
+    config.epochs = 8
+    ff = FFModel(config)
+    x_t = ff.create_tensor((32, 16))
+    t = ff.moe(x_t, num_exp=4, num_select=2, expert_hidden_size=16,
+               alpha=2.0, lambda_bal=0.04)
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 4))
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff.fit(x, y)
+    perf = ff.eval(x, y)
+    assert perf.accuracy() > 0.5, f"accuracy {perf.accuracy()}"
+
+
+def test_attention_model_trains():
+    """Transformer-block-style model through fit (exercises MHA end-to-end)."""
+    config = FFConfig()
+    config.batch_size = 16
+    config.epochs = 5
+    ff = FFModel(config)
+    x_t = ff.create_tensor((16, 8, 32))
+    a = ff.multihead_attention(x_t, x_t, x_t, embed_dim=32, num_heads=4)
+    h = ff.add(a, x_t)
+    h = ff.layer_norm(h, axes=[2])
+    h = ff.mean(h, dims=[1])
+    h = ff.dense(h, 4)
+    h = ff.softmax(h)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(128, 8, 32)).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.int32)
+    ff.fit(x, y)
+    perf = ff.eval(x, y)
+    assert perf.accuracy() > 0.7, f"accuracy {perf.accuracy()}"

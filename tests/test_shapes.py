@@ -1,0 +1,91 @@
+"""Golden-shape tests per op (SURVEY §7 stage 1: port of the reference's
+hardware-free tests/unit tier plus shape checks for every builder)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, DataType, ActiMode, AggrMode, PoolType
+
+
+def make_model():
+    return FFModel(FFConfig())
+
+
+def test_dense_shape():
+    ff = make_model()
+    x = ff.create_tensor((32, 128))
+    y = ff.dense(x, 64)
+    assert y.dims == (32, 64)
+
+
+def test_conv_pool_flat_shapes():
+    ff = make_model()
+    x = ff.create_tensor((8, 3, 32, 32))
+    c = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1)
+    assert c.dims == (8, 16, 32, 32)
+    p = ff.pool2d(c, 2, 2, 2, 2, 0, 0)
+    assert p.dims == (8, 16, 16, 16)
+    f = ff.flat(p)
+    assert f.dims == (8, 16 * 16 * 16)
+
+
+def test_concat_split_shapes():
+    ff = make_model()
+    a = ff.create_tensor((4, 10))
+    b = ff.create_tensor((4, 20))
+    c = ff.concat([a, b], axis=1)
+    assert c.dims == (4, 30)
+    parts = ff.split(c, [10, 20], axis=1)
+    assert [p.dims for p in parts] == [(4, 10), (4, 20)]
+
+
+def test_embedding_shapes():
+    ff = make_model()
+    ids = ff.create_tensor((16, 5), DataType.DT_INT32)
+    e_none = ff.embedding(ids, 1000, 32, AggrMode.AGGR_MODE_NONE)
+    assert e_none.dims == (16, 5, 32)
+    e_sum = ff.embedding(ids, 1000, 32, AggrMode.AGGR_MODE_SUM)
+    assert e_sum.dims == (16, 32)
+
+
+def test_attention_shape():
+    ff = make_model()
+    q = ff.create_tensor((2, 16, 64))
+    a = ff.multihead_attention(q, q, q, embed_dim=64, num_heads=4)
+    assert a.dims == (2, 16, 64)
+
+
+def test_topk_group_by_aggregate_shapes():
+    ff = make_model()
+    x = ff.create_tensor((32, 64))
+    gate = ff.softmax(ff.dense(x, 4))
+    values, assign = ff.top_k(gate, 2)
+    assert values.dims == (32, 2) and assign.dims == (32, 2)
+    grouped = ff.group_by(x, assign, n=4, alpha=1.0)
+    assert len(grouped) == 4
+    cap = int(np.ceil(2 * 32 * 1.0 / 4))
+    assert grouped[0].dims == (cap, 64)
+    experts = [ff.dense(g, 64) for g in grouped]
+    out = ff.aggregate(values, assign, assign, gate, experts, n=4)
+    assert out.dims == (32, 64)
+
+
+def test_reshape_transpose_shapes():
+    ff = make_model()
+    x = ff.create_tensor((4, 6, 8))
+    r = ff.reshape(x, (4, 48))
+    assert r.dims == (4, 48)
+    t = ff.transpose(x, (0, 2, 1))
+    assert t.dims == (4, 8, 6)
+    m = ff.mean(x, dims=[2])
+    assert m.dims == (4, 6)
+
+
+def test_layernorm_batchmatmul_shapes():
+    ff = make_model()
+    x = ff.create_tensor((2, 8, 16))
+    ln = ff.layer_norm(x, axes=[2])
+    assert ln.dims == (2, 8, 16)
+    a = ff.create_tensor((2, 8, 16))
+    b = ff.create_tensor((2, 16, 4))
+    bm = ff.batch_matmul(a, b)
+    assert bm.dims == (2, 8, 4)
