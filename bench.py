@@ -1,0 +1,108 @@
+"""Benchmark: BERT-Large proxy training throughput + MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): the reference publishes no absolute numbers; the
+metric is samples/sec/chip and MFU (model FLOPs / peak FLOPs), with the
+north-star target of 45% MFU for BERT-Large. vs_baseline = MFU / 0.45.
+
+Model dims per the reference proxy (examples/python/native/
+bert_proxy_native.py:12-17): seq 512, hidden 1024, 16 heads, 24 layers.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# per-chip peak bf16 FLOP/s by TPU generation
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for gen, peak in PEAK_FLOPS.items():
+        if gen in kind:
+            return peak
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.bert import (BertConfig, bert_train_flops_per_step,
+                                          build_bert)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
+                         num_heads=16, num_layers=24, intermediate=4096)
+        warmup, iters = 3, 10
+    else:  # CI smoke path
+        cfg = BertConfig.tiny(batch_size=8)
+        warmup, iters = 1, 3
+
+    config = FFConfig()
+    config.batch_size = cfg.batch_size
+    ff = FFModel(config)
+    build_bert(ff, cfg)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    step = ff.executor.make_train_step()
+    rng = np.random.default_rng(0)
+    x = [rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
+                    ).astype(np.float32)]
+    y = rng.integers(0, cfg.num_classes,
+                     size=(cfg.batch_size, 1)).astype(np.int32)
+    xd = [jax.device_put(a, ff.executor.batch_sharding(a.ndim)) for a in x]
+    yd = jax.device_put(y, ff.executor.batch_sharding(y.ndim))
+
+    import jax.random as jrandom
+
+    params, opt_state = ff.params, ff.opt_state
+    for i in range(warmup):
+        params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                          jrandom.PRNGKey(i))
+    # host readback, not block_until_ready: on tunneled platforms the latter
+    # returns before the device work completes
+    _ = float(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                          jrandom.PRNGKey(100 + i))
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    samples_per_sec = cfg.batch_size / dt
+    flops_per_step = bert_train_flops_per_step(cfg)
+    achieved = flops_per_step / dt
+    peak = detect_peak_flops() if on_tpu else 1e12
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "bert_large_train_mfu_1chip" if on_tpu
+        else "bert_tiny_train_cpu_smoke",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "step_ms": round(dt * 1e3, 2),
+        "model_flops_per_step": flops_per_step,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -368,7 +368,8 @@ class FFModel:
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                 metrics: Optional[List[MetricsType]] = None,
-                comp_mode: CompMode = CompMode.COMP_MODE_TRAINING) -> None:
+                comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+                strategy=None, strategy_fn=None) -> None:
         """Lower the Layer graph to a PCG, pick a strategy, build the executor
         (reference pipeline: src/runtime/model.cc:2803, SURVEY §3.3)."""
         from .execution.executor import Executor
@@ -418,7 +419,15 @@ class FFModel:
 
         devices = jax.devices()
         n_dev = len(devices)
-        if self.config.import_strategy_file:
+        if strategy_fn is not None:
+            strategy = strategy_fn(pcg)
+        if strategy is not None:
+            # explicit strategy (hand-written or search output)
+            self.strategy = strategy
+            self.mesh = build_mesh(self.config,
+                                   mesh_shape=strategy.mesh_shape,
+                                   axis_names=strategy.axis_names)
+        elif self.config.import_strategy_file:
             with open(self.config.import_strategy_file) as f:
                 self.strategy = Strategy.from_json(f.read(), pcg)
             self.mesh = build_mesh(self.config,

@@ -1,0 +1,3 @@
+"""Model zoo matching the reference's benchmark configs (BASELINE.md):
+AlexNet/CIFAR-10, ResNet-50, Transformer NMT, BERT-Large, DLRM, MoE."""
+from .bert import BertConfig, build_bert, bert_param_count  # noqa: F401
