@@ -386,26 +386,7 @@ class FFModel:
         self.metrics_obj = Metrics(loss_type, metrics or [])
 
         # -- create_operators_from_layers (model.cc:2785) -----------------------
-        pcg = PCG()
-        tensor_to_out: Dict[int, Tuple[int, int]] = {}
-        for t in self._input_tensors:
-            node = pcg.add_node(
-                op_class_for(OperatorType.OP_INPUT)(
-                    t.name, {"shape": t.dims, "dtype": t.dtype}, t.dtype, 0),
-                [])
-            tensor_to_out[t.guid] = (node.guid, 0)
-            self._tensor_to_node[t.guid] = node.guid
-        for layer in self._layers:
-            op = op_class_for(layer.op_type)(
-                layer.name, layer.attrs, layer.data_type,
-                num_inputs=len(layer.inputs))
-            inputs = [tensor_to_out[t.guid] for t in layer.inputs]
-            node = pcg.add_node(op, inputs)
-            self._layer_to_node[layer.guid] = node.guid
-            for i, t in enumerate(layer.outputs):
-                tensor_to_out[t.guid] = (node.guid, i)
-                self._tensor_to_node[t.guid] = node.guid
-        self.pcg = pcg
+        pcg = self.create_pcg()
 
         # final op = last compute node (the reference uses the graph's sink)
         sinks = [n for n in pcg.sinks()
@@ -477,6 +458,35 @@ class FFModel:
                                  self.final_guid, label_dtype, repl_labels)
         self.params = self.executor.init_params(self.config.numpy_seed())
         self.opt_state = self.optimizer.init_state(self.params)
+
+    def create_pcg(self):
+        """Layer graph -> PCG (reference: create_operators_from_layers,
+        src/runtime/model.cc:2785). Usable standalone for search experiments
+        without allocating parameters."""
+        from .parallel.pcg import PCG
+        from .ops.base import op_class_for
+
+        pcg = PCG()
+        tensor_to_out: Dict[int, Tuple[int, int]] = {}
+        for t in self._input_tensors:
+            node = pcg.add_node(
+                op_class_for(OperatorType.OP_INPUT)(
+                    t.name, {"shape": t.dims, "dtype": t.dtype}, t.dtype, 0),
+                [])
+            tensor_to_out[t.guid] = (node.guid, 0)
+            self._tensor_to_node[t.guid] = node.guid
+        for layer in self._layers:
+            op = op_class_for(layer.op_type)(
+                layer.name, layer.attrs, layer.data_type,
+                num_inputs=len(layer.inputs))
+            inputs = [tensor_to_out[t.guid] for t in layer.inputs]
+            node = pcg.add_node(op, inputs)
+            self._layer_to_node[layer.guid] = node.guid
+            for i, t in enumerate(layer.outputs):
+                tensor_to_out[t.guid] = (node.guid, i)
+                self._tensor_to_node[t.guid] = node.guid
+        self.pcg = pcg
+        return pcg
 
     def _run_search(self, pcg, n_dev):
         from .parallel.strategy import data_parallel_strategy

@@ -1,0 +1,235 @@
+"""Cost model + task-graph simulator for candidate parallelization strategies.
+
+Rebuild of the reference's Simulator (src/runtime/simulator.cc:1880 —
+``measure_operator_cost`` caching per-(op,view) timings and
+``simulate_runtime`` event-driven execution of the task graph with comm
+tasks). TPU-native differences (SURVEY §7 hard-part 3):
+
+* Per-op cost comes from a **roofline model** over the TPUMachineModel
+  (max(FLOPs/peak, bytes/HBM-bw)) instead of cudaEvent microbenchmarks —
+  XLA fusion makes isolated per-op timing misleading; the analytical model is
+  calibrated against measured end-to-end steps (``calibrate``).
+* Communication is costed with α-β collective formulas over ICI instead of
+  per-link event simulation — SPMD collectives are compiler-scheduled, not
+  runtime-scheduled.
+* Optional measured mode (``measure_operator_cost``) jit-times a single op
+  standalone on the real chip and caches by (op params, sharding), mirroring
+  the reference's cache keyed by op + MachineView.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ffconst import OperatorType, size_of_datatype
+from ..parallel.pcg import PCG, PCGNode
+from .machine_model import TPUMachineModel
+
+# ops whose cost is MXU-bound
+_MATMUL_OPS = {
+    OperatorType.OP_LINEAR, OperatorType.OP_CONV2D,
+    OperatorType.OP_BATCHMATMUL, OperatorType.OP_MULTIHEAD_ATTENTION,
+    OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
+    OperatorType.OP_AGG_SPEC,
+}
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Per-op costs (reference: simulator.h:54-88)."""
+
+    forward_time: float = 0.0  # seconds
+    backward_time: float = 0.0
+    sync_time: float = 0.0  # gradient allreduce
+    comm_time: float = 0.0  # activation resharding
+    inputs_memory: int = 0
+    outputs_memory: int = 0
+    weights_memory: int = 0
+
+    def total_time(self) -> float:
+        return (self.forward_time + self.backward_time + self.sync_time
+                + self.comm_time)
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSharding:
+    """The search's per-op decision: data-parallel degree, model(tensor)
+    degree, and how the model degree is applied. TPU-native MachineView
+    (SURVEY §7: the searched space of the reference's
+    register_all_machine_views is 1-D divisor-degree views — (dp, tp)
+    factorizations cover it)."""
+
+    dp: int = 1
+    tp: int = 1
+    kind: str = "none"  # none|col|row|heads|table|expert
+
+    @property
+    def degree(self) -> int:
+        return self.dp * (self.tp if self.kind != "none" else 1)
+
+
+class Simulator:
+    def __init__(self, machine: TPUMachineModel,
+                 overlap_backward_update: bool = False):
+        self.machine = machine
+        self.overlap = overlap_backward_update
+        self._measure_cache: Dict[Tuple, float] = {}
+        self.calibration = 1.0  # measured/analytical scale factor
+
+    # ------------------------------------------------------------ per-op cost
+    def op_cost(self, node: PCGNode, in_shapes: List[Tuple[int, ...]],
+                sh: OpSharding) -> CostMetrics:
+        m = self.machine
+        op = node.op
+        out_shapes = node.out_shapes
+        el = size_of_datatype(op.data_type)
+
+        flops = op.flops(in_shapes, out_shapes)
+        in_bytes = sum(int(np.prod(s)) for s in in_shapes) * el
+        out_bytes = sum(int(np.prod(s)) for s in out_shapes) * el
+        w_bytes = sum(int(np.prod(spec[0]))
+                      for spec in op.weight_specs(in_shapes).values()) * el
+
+        deg = max(sh.degree, 1)
+        shard_flops = flops / deg
+        shard_bytes = (in_bytes + out_bytes) / deg + w_bytes / max(
+            sh.tp if sh.kind in ("col", "row", "heads", "table") else 1, 1)
+
+        if op.op_type in _MATMUL_OPS:
+            compute = shard_flops / (m.peak_flops * m.matmul_efficiency)
+        else:
+            compute = shard_flops / (m.peak_flops_f32 * m.matmul_efficiency)
+        mem_time = shard_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
+        fwd = max(compute, mem_time) * self.calibration
+        # backward ~ 2x forward for weight-bearing ops, 1x otherwise
+        bwd = fwd * (2.0 if w_bytes else 1.0)
+
+        # intra-op collective: row-parallel / head-parallel psum of the output
+        comm = 0.0
+        if sh.kind in ("row", "heads", "table") and sh.tp > 1:
+            comm = m.allreduce_time(out_bytes // max(sh.dp, 1), sh.tp)
+
+        # gradient sync: weights replicated over dp -> allreduce over dp
+        sync = 0.0
+        if w_bytes and sh.dp > 1:
+            shard_w = w_bytes // max(
+                sh.tp if sh.kind in ("col", "row", "heads", "table") else 1, 1)
+            sync = m.allreduce_time(shard_w, sh.dp)
+
+        return CostMetrics(
+            forward_time=fwd, backward_time=bwd, sync_time=sync,
+            comm_time=comm,
+            inputs_memory=int(in_bytes / deg),
+            outputs_memory=int(out_bytes / deg),
+            weights_memory=int(w_bytes / max(
+                sh.tp if sh.kind in ("col", "row", "heads", "table") else 1,
+                1)))
+
+    # ----------------------------------------------------- transition costs
+    def resharding_cost(self, bytes_total: int, src_state: str,
+                        dst_state: str, dp: int, tp: int) -> float:
+        """Cost of moving an activation between sharding states.
+
+        States: 'R' = sharded over data only (replicated over model axis),
+        'S' = additionally sharded over the model axis. These are the
+        Repartition/Combine parallel ops of the reference (src/parallel_ops/):
+        R->S is a local slice (free); S->R is an all-gather over tp.
+        """
+        if src_state == dst_state or tp <= 1:
+            return 0.0
+        per_chip = bytes_total // max(dp * tp, 1)
+        if src_state == "S" and dst_state == "R":
+            return self.machine.allgather_time(per_chip, tp)
+        return 0.0  # R->S: local slice
+
+    # ------------------------------------------------------- whole-graph sim
+    def simulate(self, pcg: PCG,
+                 assignment: Dict[int, OpSharding],
+                 states: Optional[Dict[int, str]] = None
+                 ) -> Tuple[float, int]:
+        """Estimate one training-step time (s) and per-chip memory (bytes)
+        for a full per-op assignment (reference: simulate_runtime,
+        simulator.cc:815). Sequential compute + exposed communication; with
+        ``--overlap`` gradient sync hides behind backward compute."""
+        total_compute = 0.0
+        total_comm = 0.0
+        total_sync = 0.0
+        total_bwd = 0.0
+        mem = 0
+        states = states or {}
+        el_cache: Dict[int, CostMetrics] = {}
+        for node in pcg.compute_nodes():
+            sh = assignment.get(node.guid, OpSharding())
+            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+            cm = self.op_cost(node, in_shapes, sh)
+            el_cache[node.guid] = cm
+            total_compute += cm.forward_time + cm.backward_time
+            total_bwd += cm.backward_time
+            total_comm += cm.comm_time
+            total_sync += cm.sync_time
+            # activation memory: outputs + grads (x2), weights + opt state (x3)
+            mem += cm.outputs_memory * 2 + cm.weights_memory * 4
+            # resharding on input edges
+            my_state = states.get(node.guid, "R")
+            for g, i in node.inputs:
+                src = pcg.nodes[g]
+                if src.op.op_type in (OperatorType.OP_INPUT,
+                                      OperatorType.OP_WEIGHT):
+                    continue
+                src_state = states.get(g, "R")
+                nbytes = int(np.prod(src.out_shapes[i])) * size_of_datatype(
+                    src.op.data_type)
+                total_comm += self.resharding_cost(
+                    nbytes, src_state, my_state, sh.dp, sh.tp)
+        if self.overlap:
+            total_sync = max(0.0, total_sync - 0.7 * total_bwd)
+        return total_compute + total_comm + total_sync, mem
+
+    # -------------------------------------------- measured mode (on device)
+    def measure_operator_cost(self, node: PCGNode,
+                              in_shapes: List[Tuple[int, ...]],
+                              iters: int = 5) -> float:
+        """Time one op standalone on the current backend, cached by params key
+        (reference: measure_operator_cost, simulator.cc:489 — cudaEvents;
+        here wall clock around a host readback)."""
+        key = (node.op.params_key(), tuple(map(tuple, in_shapes)))
+        if key in self._measure_cache:
+            return self._measure_cache[key]
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ffconst import dtype_to_jnp
+        from ..ops.base import OpContext
+
+        op = node.op
+        dt = dtype_to_jnp(op.data_type)
+        xs = [jnp.ones(s, dt) for s in in_shapes]
+        params = {}
+        key_rng = jax.random.PRNGKey(0)
+        for wname, (shape, wdt, init) in op.weight_specs(in_shapes).items():
+            params[wname] = init(key_rng, shape, dtype_to_jnp(wdt))
+        ctx = OpContext(training=False)
+
+        @jax.jit
+        def f(params, xs):
+            return op.forward(params, list(xs), ctx)
+
+        outs = f(params, xs)
+        _ = np.asarray(jax.tree_util.tree_leaves(outs)[0]).ravel()[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = f(params, xs)
+        _ = np.asarray(jax.tree_util.tree_leaves(outs)[0]).ravel()[0]
+        t = (time.perf_counter() - t0) / iters
+        self._measure_cache[key] = t
+        return t
+
+    def calibrate(self, measured_step: float, simulated_step: float) -> None:
+        """Scale the analytical model so simulated == measured for a known
+        config (replaces cudaEvent ground truth)."""
+        if simulated_step > 0:
+            self.calibration *= measured_step / simulated_step

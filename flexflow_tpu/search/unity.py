@@ -1,0 +1,382 @@
+"""Unity-style auto-parallelization search, TPU-native.
+
+Rebuild of the reference's search stack (SURVEY §2.1 L4a): GraphSearchHelper's
+outer optimization (substitution.cc:1898), SearchHelper's DP over per-node
+MachineViews (graph.h:170-283), memory-aware λ search (graph.cc:2060-2133),
+and the legacy MCMC fallback (model.cc:3285).
+
+TPU-native reformulation (SURVEY §7): the reference searches over graph
+substitutions that insert partition/combine/replicate/reduction nodes and
+assigns 1-D divisor-degree MachineViews (register_all_machine_views,
+graph.cc:2329). Under XLA SPMD that space is exactly: (a) a mesh factorization
+(dp, tp) of the chip count, and (b) a per-op choice of how the tp axis is
+applied (none / column / row / heads / table / expert) with resharding
+transitions between choices. The search here:
+
+  outer loop over (dp, tp) factorizations     == enumerating MachineView grids
+  per-chain Viterbi DP over sharding states   == find_optimal_sequence_graph_time
+  transition costs from the Simulator         == estimate_xfer_cost
+  alpha pruning + budget                      == base_optimize's best-first prune
+  memory λ binary search                      == graph_optimize_task λ loop
+  MCMC fallback (--search-budget, no DP)      == FFModel::mcmc_optimize
+
+The output is a Strategy (per-op shardings) — the same artifact the reference
+serializes as optimal_views.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ffconst import OperatorType
+from ..machine_view import MachineView
+from ..parallel.pcg import PCG, PCGNode
+from ..parallel.strategy import NodeStrategy, Strategy
+from ..utils.recursive_logger import RecursiveLogger
+from .machine_model import TPUMachineModel
+from .simulator import OpSharding, Simulator
+
+_log = RecursiveLogger("unity")
+
+# per-op tp options: (kind, required input state, produced output state)
+#   states: 'R' = batch-sharded only; 'S' = also sharded over the model axis
+_TP_OPTIONS: Dict[OperatorType, List[Tuple[str, str, str]]] = {
+    OperatorType.OP_LINEAR: [("none", "R", "R"), ("col", "R", "S"),
+                             ("row", "S", "R")],
+    OperatorType.OP_MULTIHEAD_ATTENTION: [("none", "R", "R"),
+                                          ("heads", "R", "R")],
+    OperatorType.OP_EMBEDDING: [("none", "R", "R"), ("table", "R", "R")],
+    OperatorType.OP_CONV2D: [("none", "R", "R"), ("col", "R", "S")],
+}
+# state-preserving ops (elementwise etc.) pass S through; everything else
+# demands R input
+_STATE_PRESERVING = {
+    OperatorType.OP_RELU, OperatorType.OP_GELU, OperatorType.OP_TANH,
+    OperatorType.OP_SIGMOID, OperatorType.OP_ELU, OperatorType.OP_IDENTITY,
+    OperatorType.OP_DROPOUT, OperatorType.OP_SCALAR_MULTIPLY,
+    OperatorType.OP_SCALAR_ADD, OperatorType.OP_SCALAR_SUB,
+    OperatorType.OP_SCALAR_TRUE_DIV, OperatorType.OP_CAST,
+    OperatorType.OP_EXP, OperatorType.OP_POW,
+}
+
+
+@dataclasses.dataclass
+class SearchResult:
+    strategy: Strategy
+    assignment: Dict[int, OpSharding]
+    sim_time: float
+    sim_memory: int
+    mesh_shape: Tuple[int, int]
+
+
+def factorizations(n: int) -> List[Tuple[int, int]]:
+    """(dp, tp) pairs with dp*tp == n (reference: divisor-degree views)."""
+    out = []
+    for tp in range(1, n + 1):
+        if n % tp == 0:
+            out.append((n // tp, tp))
+    return out
+
+
+def _tp_valid(node: PCGNode, kind: str, tp: int,
+              in_shapes: List[Tuple[int, ...]]) -> bool:
+    """Divisibility checks (reference: get_valid_machine_views)."""
+    a = node.op.attrs
+    if kind == "none":
+        return True
+    if node.op.op_type == OperatorType.OP_LINEAR:
+        if kind == "col":
+            return a["out_dim"] % tp == 0
+        if kind == "row":
+            return in_shapes[0][-1] % tp == 0
+    if node.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+        return a["num_heads"] % tp == 0
+    if node.op.op_type == OperatorType.OP_EMBEDDING:
+        return a["num_entries"] % tp == 0
+    if node.op.op_type == OperatorType.OP_CONV2D:
+        return a["out_channels"] % tp == 0
+    return False
+
+
+def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
+              batch_size: int) -> Tuple[Dict[int, OpSharding],
+                                        Dict[int, str], float]:
+    """Viterbi DP over the topo order: per node, cost table keyed by output
+    state; transitions pay resharding (reference:
+    find_optimal_sequence_graph_time + estimate_xfer_cost). At fan-out/fan-in
+    points the state is pinned to 'R' (the reference's sequence-split
+    bottlenecks are exactly such points)."""
+    from ..ffconst import size_of_datatype
+
+    nodes = pcg.compute_nodes()
+    consumers: Dict[int, int] = {}
+    for n in nodes:
+        for g, _ in n.inputs:
+            consumers[g] = consumers.get(g, 0) + 1
+
+    # dp over (node, out_state) -> (cost, back-pointer (choice, in_state))
+    INF = float("inf")
+    table: Dict[int, Dict[str, Tuple[float, Tuple[str, str]]]] = {}
+    for node in nodes:
+        in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+        opts = _TP_OPTIONS.get(node.op.op_type)
+        if opts is None:
+            if node.op.op_type in _STATE_PRESERVING and len(node.inputs) == 1:
+                opts = [("none", "R", "R"), ("none", "S", "S")]
+            else:
+                opts = [("none", "R", "R")]
+        # producer state tables (compute nodes only; sources are state R)
+        def prev_cost(state: str) -> float:
+            total = 0.0
+            for g, i in node.inputs:
+                p = pcg.nodes[g]
+                if p.op.op_type in (OperatorType.OP_INPUT,
+                                    OperatorType.OP_WEIGHT):
+                    continue
+                ptab = table.get(g)
+                if ptab is None:
+                    continue
+                if state in ptab and ptab[state][0] < INF:
+                    total += ptab[state][0]
+                else:
+                    # pay an all-gather to convert
+                    other = "S" if state == "R" else "R"
+                    if other not in ptab or ptab[other][0] >= INF:
+                        return INF
+                    nbytes = int(np.prod(p.out_shapes[i])) * \
+                        size_of_datatype(p.op.data_type)
+                    total += ptab[other][0] + sim.resharding_cost(
+                        nbytes, other, state, dp, tp)
+            return total
+
+        # multi-consumer producers or multi-input nodes pin states to R
+        multi_in = len([1 for g, _ in node.inputs
+                        if pcg.nodes[g].op.op_type not in
+                        (OperatorType.OP_INPUT, OperatorType.OP_WEIGHT)]) > 1
+
+        tab: Dict[str, Tuple[float, Tuple[str, str]]] = {}
+        for kind, in_state, out_state in opts:
+            if multi_in and in_state != "R":
+                continue
+            if consumers.get(node.guid, 0) > 1 and out_state != "R":
+                continue
+            eff_tp = tp if kind != "none" else 1
+            if not _tp_valid(node, kind, tp, in_shapes):
+                continue
+            sh = OpSharding(dp=dp, tp=eff_tp, kind=kind)
+            cm = sim.op_cost(node, in_shapes, sh)
+            base = prev_cost(in_state)
+            if base >= INF:
+                continue
+            c = base + cm.total_time()
+            if out_state not in tab or c < tab[out_state][0]:
+                tab[out_state] = (c, (kind, in_state))
+        if not tab:  # fallback: unsharded
+            sh = OpSharding(dp=dp, tp=1, kind="none")
+            cm = sim.op_cost(node, in_shapes, sh)
+            tab["R"] = (prev_cost("R") + cm.total_time(), ("none", "R"))
+        table[node.guid] = tab
+
+    # backtrack: choose best final state, then walk back greedily per node
+    # (the chain DP is exact on chains; at joins states were pinned to R)
+    assignment: Dict[int, OpSharding] = {}
+    states: Dict[int, str] = {}
+    # choose states from sinks backwards
+    chosen: Dict[int, str] = {}
+    for node in reversed(nodes):
+        tab = table[node.guid]
+        if node.guid not in chosen:
+            # unconstrained: pick cheapest state
+            st = min(tab, key=lambda s: tab[s][0])
+            chosen[node.guid] = st
+        st = chosen[node.guid]
+        kind, in_state = tab[st][1]
+        eff_tp = tp if kind != "none" else 1
+        assignment[node.guid] = OpSharding(dp=dp, tp=eff_tp, kind=kind)
+        states[node.guid] = st
+        for g, _ in node.inputs:
+            p = pcg.nodes[g]
+            if p.op.op_type not in (OperatorType.OP_INPUT,
+                                    OperatorType.OP_WEIGHT) \
+                    and g not in chosen:
+                ptab = table[g]
+                chosen[g] = in_state if in_state in ptab else \
+                    min(ptab, key=lambda s: ptab[s][0])
+    # total time: recompute via simulate so resharding edges are counted once
+    sim_time, _ = sim.simulate(pcg, assignment, states)
+    return assignment, states, sim_time
+
+
+def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
+                           states: Dict[int, str], dp: int, tp: int,
+                           data_axis: str = "data",
+                           model_axis: str = "model") -> Strategy:
+    """Materialize the search result as weight/output shardings (the
+    reference's convert_graph_to_operators + optimal_views)."""
+    if tp == 1:
+        s = Strategy(mesh_shape=(dp,), axis_names=(data_axis,),
+                     data_axis=data_axis)
+    else:
+        s = Strategy(mesh_shape=(dp, tp), axis_names=(data_axis, model_axis),
+                     data_axis=data_axis)
+    view = MachineView(dim=(dp, tp) if tp > 1 else (dp,),
+                       stride=(tp, 1) if tp > 1 else (1,))
+    for node in pcg.topo_order():
+        ns = s.for_node(node.guid)
+        ns.view = view
+        sh = assignment.get(node.guid)
+        if sh is None or sh.kind == "none" or sh.tp == 1:
+            continue
+        ot = node.op.op_type
+        if ot == OperatorType.OP_LINEAR:
+            if sh.kind == "col":
+                ns.weight_specs = {"kernel": (None, model_axis),
+                                   "bias": (model_axis,)}
+                ndim = len(node.out_shapes[0])
+                ns.output_spec = (data_axis,) + (None,) * (ndim - 2) + (
+                    model_axis,)
+            elif sh.kind == "row":
+                ns.weight_specs = {"kernel": (model_axis, None),
+                                   "bias": (None,)}
+                ndim = len(node.out_shapes[0])
+                ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+        elif ot == OperatorType.OP_MULTIHEAD_ATTENTION:
+            ns.weight_specs = {"wq": (None, model_axis, None),
+                               "wk": (None, model_axis, None),
+                               "wv": (None, model_axis, None),
+                               "wo": (model_axis, None, None),
+                               "bo": (None,)}
+            ndim = len(node.out_shapes[0])
+            ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+        elif ot == OperatorType.OP_EMBEDDING:
+            ns.weight_specs = {"weight": (model_axis, None)}
+            ndim = len(node.out_shapes[0])
+            ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+        elif ot == OperatorType.OP_CONV2D:
+            ns.weight_specs = {"kernel": (None, None, None, model_axis),
+                               "bias": (model_axis,)}
+    return s
+
+
+def unity_search(pcg: PCG, config, n_dev: int,
+                 machine: Optional[TPUMachineModel] = None,
+                 return_result: bool = False):
+    """Top-level search (reference: graph_optimize_task, graph.cc:2047).
+
+    Enumerates mesh factorizations, runs the per-op DP for each, applies
+    alpha pruning, then the memory-λ feasibility loop. Returns a Strategy.
+    """
+    if machine is None:
+        if config.machine_model_version == 1 and config.machine_model_file:
+            machine = TPUMachineModel.from_file(config.machine_model_file,
+                                               n_dev)
+        else:
+            machine = TPUMachineModel.detect(n_dev)
+    sim = Simulator(machine, config.search_overlap_backward_update)
+
+    batch = config.batch_size
+    best: Optional[SearchResult] = None
+    alpha = config.search_alpha
+    budget = config.search_budget if config.search_budget > 0 else 10 ** 9
+    explored = 0
+    with _log.scope("unity_search n_dev=%d" % n_dev):
+        for dp, tp in factorizations(n_dev):
+            if batch % dp != 0:
+                continue
+            if explored >= budget:
+                break
+            explored += 1
+            assignment, states, t = dp_assign(pcg, sim, dp, tp, batch)
+            _, mem = sim.simulate(pcg, assignment, states)
+            _log.info("mesh dp=%d tp=%d -> %.3f ms, %.1f MiB/chip",
+                      dp, tp, t * 1e3, mem / 2 ** 20)
+            if best is not None and t > best.sim_time * alpha:
+                continue
+            if best is None or t < best.sim_time:
+                best = SearchResult(
+                    strategy=assignment_to_strategy(pcg, assignment, states,
+                                                    dp, tp),
+                    assignment=assignment, sim_time=t, sim_memory=mem,
+                    mesh_shape=(dp, tp))
+
+    # memory-aware λ loop (reference: graph.cc:2060-2133): if the best
+    # strategy exceeds per-chip HBM, penalize memory until one fits
+    if best is not None and config.perform_memory_search and \
+            best.sim_memory > machine.hbm_capacity:
+        feasible = [r for r in _all_results(pcg, sim, n_dev, batch)
+                    if r.sim_memory <= machine.hbm_capacity]
+        if feasible:
+            best = min(feasible, key=lambda r: r.sim_time)
+
+    if best is None:
+        from ..parallel.strategy import data_parallel_strategy
+
+        return data_parallel_strategy(pcg, n_dev)
+    return (best if return_result else best.strategy)
+
+
+def _all_results(pcg, sim, n_dev, batch):
+    out = []
+    for dp, tp in factorizations(n_dev):
+        if batch % dp != 0:
+            continue
+        assignment, states, t = dp_assign(pcg, sim, dp, tp, batch)
+        _, mem = sim.simulate(pcg, assignment, states)
+        out.append(SearchResult(
+            strategy=assignment_to_strategy(pcg, assignment, states, dp, tp),
+            assignment=assignment, sim_time=t, sim_memory=mem,
+            mesh_shape=(dp, tp)))
+    return out
+
+
+# ---------------------------------------------------------------- legacy MCMC
+def mcmc_optimize(pcg: PCG, config, n_dev: int,
+                  machine: Optional[TPUMachineModel] = None,
+                  iterations: int = 500, temperature: float = 1e-4,
+                  seed: int = 0) -> Strategy:
+    """Legacy simulated-annealing search over per-op shardings
+    (reference: FFModel::mcmc_optimize, model.cc:3285 — random per-op
+    ParallelConfig rewrites accepted by Metropolis criterion)."""
+    machine = machine or TPUMachineModel.detect(n_dev)
+    sim = Simulator(machine)
+    rng = random.Random(seed)
+    batch = config.batch_size
+
+    facts = [f for f in factorizations(n_dev) if batch % f[0] == 0]
+    dp, tp = facts[0]
+    nodes = pcg.compute_nodes()
+
+    def random_choice(node):
+        opts = _TP_OPTIONS.get(node.op.op_type, [("none", "R", "R")])
+        in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+        valid = [o for o in opts if _tp_valid(node, o[0], tp, in_shapes)]
+        return rng.choice(valid or [("none", "R", "R")])
+
+    current = {n.guid: OpSharding(dp=dp, tp=tp if k != "none" else 1, kind=k)
+               for n in nodes for k, _, _ in [random_choice(n)]}
+    cur_t, _ = sim.simulate(pcg, current)
+    best, best_t = dict(current), cur_t
+    for it in range(iterations):
+        # occasionally rewrite the mesh factorization (reference: restart)
+        if it % 100 == 99 and len(facts) > 1:
+            dp, tp = rng.choice(facts)
+            current = {n.guid: OpSharding(
+                dp=dp, tp=tp if k != "none" else 1, kind=k)
+                for n in nodes for k, _, _ in [random_choice(n)]}
+            cur_t, _ = sim.simulate(pcg, current)
+        node = rng.choice(nodes)
+        kind, _, _ = random_choice(node)
+        cand = dict(current)
+        cand[node.guid] = OpSharding(dp=dp, tp=tp if kind != "none" else 1,
+                                     kind=kind)
+        t, _ = sim.simulate(pcg, cand)
+        if t < cur_t or rng.random() < math.exp(-(t - cur_t) / temperature):
+            current, cur_t = cand, t
+            if t < best_t:
+                best, best_t = dict(cand), t
+    states = {n.guid: "R" for n in nodes}
+    return assignment_to_strategy(pcg, best, states, dp, tp)

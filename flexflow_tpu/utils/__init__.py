@@ -1,0 +1,1 @@
+from .recursive_logger import RecursiveLogger  # noqa: F401
