@@ -1,3 +1,8 @@
 """Model zoo matching the reference's benchmark configs (BASELINE.md):
 AlexNet/CIFAR-10, ResNet-50, Transformer NMT, BERT-Large, DLRM, MoE."""
 from .bert import BertConfig, build_bert, bert_param_count  # noqa: F401
+from .vision import (build_alexnet, build_alexnet_cifar10,  # noqa: F401
+                     build_resnet50)
+from .dlrm import build_dlrm  # noqa: F401
+from .transformer import (TransformerConfig, build_transformer,  # noqa: F401
+                          build_moe_mlp)

@@ -1,0 +1,67 @@
+"""Transformer encoder (the OSDI'22 BERT-proxy benchmark model) and MoE net.
+
+Reference: examples/cpp/Transformer/transformer.cc:33-85 — 12 layers, hidden
+1024, 16 heads, seq 512; each layer = MHA + residual + 2-layer FFN (no
+layernorm in the reference's proxy — kept optional here);
+examples/cpp/mixture_of_experts/moe.cc — MNIST MLP with an MoE layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..ffconst import ActiMode
+from ..model import FFModel
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    batch_size: int = 8
+    seq_len: int = 512
+    hidden: int = 1024
+    num_heads: int = 16
+    num_layers: int = 12
+    use_layernorm: bool = False  # the reference proxy omits LN
+
+    @staticmethod
+    def tiny(batch_size: int = 8) -> "TransformerConfig":
+        return TransformerConfig(batch_size=batch_size, seq_len=16, hidden=32,
+                                 num_heads=4, num_layers=2)
+
+
+def build_transformer(ff: FFModel, cfg: TransformerConfig):
+    """reference transformer.cc create_attention_encoder: MHA -> dense(relu)
+    -> dense."""
+    x = ff.create_tensor((cfg.batch_size, cfg.seq_len, cfg.hidden),
+                         name="transformer_input")
+    t = x
+    for layer in range(cfg.num_layers):
+        attn = ff.multihead_attention(t, t, t, embed_dim=cfg.hidden,
+                                      num_heads=cfg.num_heads,
+                                      name=f"t{layer}_attn")
+        if cfg.use_layernorm:
+            attn = ff.layer_norm(ff.add(attn, t), axes=[2],
+                                 name=f"t{layer}_ln1")
+        h = ff.dense(attn, cfg.hidden, ActiMode.AC_MODE_RELU,
+                     name=f"t{layer}_fc1")
+        h = ff.dense(h, cfg.hidden, name=f"t{layer}_fc2")
+        t = ff.layer_norm(ff.add(h, attn), axes=[2], name=f"t{layer}_ln2") \
+            if cfg.use_layernorm else h
+    # per-token LM-style head to keep the output shape (reference trains
+    # against a replicated label tensor)
+    pooled = ff.mean(t, dims=[1], name="pool")
+    logits = ff.dense(pooled, 2, name="head")
+    return x, ff.softmax(logits)
+
+
+def build_moe_mlp(ff: FFModel, batch_size: int = 64, in_dim: int = 784,
+                  num_classes: int = 10, num_exp: int = 8,
+                  num_select: int = 2, expert_hidden: int = 64,
+                  alpha: float = 2.0, lambda_bal: float = 0.04):
+    """reference: examples/cpp/mixture_of_experts/moe.cc top_level_task."""
+    x = ff.create_tensor((batch_size, in_dim), name="moe_input")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU)
+    t = ff.moe(t, num_exp=num_exp, num_select=num_select,
+               expert_hidden_size=expert_hidden, alpha=alpha,
+               lambda_bal=lambda_bal)
+    t = ff.dense(t, num_classes)
+    return x, ff.softmax(t)

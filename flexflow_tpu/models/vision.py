@@ -1,0 +1,90 @@
+"""Vision models: AlexNet, ResNet-50, InceptionV3-style stem.
+
+Reference builders: examples/cpp/AlexNet/alexnet.cc:40-126 (conv stack +
+4096-dense head), examples/cpp/ResNet/resnet.cc (bottleneck blocks),
+bootcamp_demo/ff_alexnet_cifar10.py (CIFAR-10 variant). Same FFModel builder
+calls, NCHW layout.
+"""
+from __future__ import annotations
+
+from ..ffconst import ActiMode, PoolType
+from ..model import FFModel
+
+
+def build_alexnet(ff: FFModel, batch_size: int = 64, image_size: int = 224,
+                  num_classes: int = 1000):
+    """reference: examples/cpp/AlexNet/alexnet.cc (conv 64/192/384/256/256)."""
+    x = ff.create_tensor((batch_size, 3, image_size, image_size),
+                         name="alexnet_input")
+    t = ff.conv2d(x, 64, 11, 11, 4, 4, 2, 2, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 4096, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4096, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, num_classes)
+    return x, ff.softmax(t)
+
+
+def build_alexnet_cifar10(ff: FFModel, batch_size: int = 64):
+    """CIFAR-10 AlexNet (reference: bootcamp_demo/ff_alexnet_cifar10.py):
+    smaller strides for 32x32 inputs."""
+    x = ff.create_tensor((batch_size, 3, 32, 32), name="cifar_input")
+    t = ff.conv2d(x, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 192, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    return x, ff.softmax(t)
+
+
+def _bottleneck(ff: FFModel, t, out_channels: int, stride: int,
+                projection: bool, name: str):
+    """ResNet bottleneck (reference: examples/cpp/ResNet BottleneckBlock)."""
+    shortcut = t
+    c = ff.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c1")
+    c = ff.batch_norm(c, relu=True, name=f"{name}_bn1")
+    c = ff.conv2d(c, out_channels, 3, 3, stride, stride, 1, 1,
+                  name=f"{name}_c2")
+    c = ff.batch_norm(c, relu=True, name=f"{name}_bn2")
+    c = ff.conv2d(c, 4 * out_channels, 1, 1, 1, 1, 0, 0, name=f"{name}_c3")
+    c = ff.batch_norm(c, relu=False, name=f"{name}_bn3")
+    if projection:
+        shortcut = ff.conv2d(shortcut, 4 * out_channels, 1, 1, stride, stride,
+                             0, 0, name=f"{name}_proj")
+        shortcut = ff.batch_norm(shortcut, relu=False, name=f"{name}_bnp")
+    out = ff.add(c, shortcut)
+    return ff.relu(out)
+
+
+def build_resnet50(ff: FFModel, batch_size: int = 64, image_size: int = 224,
+                   num_classes: int = 1000, stages=(3, 4, 6, 3)):
+    x = ff.create_tensor((batch_size, 3, image_size, image_size),
+                         name="resnet_input")
+    t = ff.conv2d(x, 64, 7, 7, 2, 2, 3, 3, name="stem")
+    t = ff.batch_norm(t, relu=True, name="stem_bn")
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
+    channels = 64
+    for stage, blocks in enumerate(stages):
+        for b in range(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            t = _bottleneck(ff, t, channels, stride, projection=(b == 0),
+                            name=f"s{stage}b{b}")
+        channels *= 2
+    # global average pool: kernel = remaining spatial extent (the reference
+    # hardcodes 7x7 for 224px inputs)
+    _, _, fh, fw = t.dims
+    t = ff.pool2d(t, fh, fw, 1, 1, 0, 0, PoolType.POOL_AVG)
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes)
+    return x, ff.softmax(t)
