@@ -42,6 +42,11 @@ class Executor:
         self._eval_step = None
         self._forward_jit = None
 
+        # apply strategy op-attr overrides (e.g. ring-attention seq axis)
+        for guid, ns in strategy.node_strategies.items():
+            if ns.extra and guid in pcg.nodes:
+                pcg.nodes[guid].op.attrs.update(ns.extra)
+
     # ------------------------------------------------------------------ sharding
     def _named_sharding(self, spec_entries):
         from jax.sharding import NamedSharding, PartitionSpec

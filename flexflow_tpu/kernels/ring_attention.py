@@ -1,0 +1,96 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+No reference analog (SURVEY §5: the reference has no sequence parallelism —
+it scales sequence length only by sharding heads/samples); this is the
+TPU-native extension that makes long-context first-class. The sequence dim of
+q/k/v is sharded over the ``seq`` mesh axis; each chip holds one block of
+queries and rotates k/v blocks around the ICI ring with
+``lax.ppermute``, accumulating blockwise online-softmax partial results
+(the RingAttention / blockwise-parallel-transformer recipe). Peak memory per
+chip is O(s/P * s/P) per step instead of O(s^2); comm rides neighbor ICI
+links and overlaps with the next block's compute (XLA schedules the
+ppermute DMA asynchronously).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, causal: bool):
+    """One (q-block, k-block) partial: returns (m, l, acc) in f32.
+
+    q: (b, h, sq, d), k/v: (b, h, sk, d); offsets are global positions of the
+    blocks for causal masking.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (b,h,sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
+                   causal: bool = False, data_axis: Optional[str] = "data"):
+    """q,k,v: (batch, heads, seq, head_dim), seq sharded over ``seq_axis``.
+
+    Must be called under jit with ``mesh``; returns the attention output with
+    the same sharding as q.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_seq = mesh.shape[seq_axis]
+    batch_spec = data_axis if (data_axis and data_axis in mesh.shape) else None
+    spec = P(batch_spec, None, seq_axis, None)
+
+    def local(q_blk, k_blk, v_blk):
+        # q_blk: (b_local, h, s_local, d)
+        s_local = q_blk.shape[2]
+        my = jax.lax.axis_index(seq_axis)
+        perm = [(j, (j + 1) % n_seq) for j in range(n_seq)]
+
+        # derive the carry init from q_blk so it carries the same
+        # device-varying type under shard_map
+        m0 = jnp.full_like(q_blk[..., 0], NEG_INF, dtype=jnp.float32)
+        l0 = jnp.zeros_like(q_blk[..., 0], dtype=jnp.float32)
+        a0 = jnp.zeros_like(q_blk, dtype=jnp.float32)
+
+        def step(carry, i):
+            m, l, acc, k_cur, v_cur = carry
+            src = (my - i) % n_seq  # whose k/v block we currently hold
+            bm, bl, bacc = _block_attn(q_blk, k_cur, v_cur,
+                                       my * s_local, src * s_local, causal)
+            m_new = jnp.maximum(m, bm)
+            scale_old = jnp.exp(m - m_new)
+            scale_new = jnp.exp(bm - m_new)
+            l_new = l * scale_old + bl * scale_new
+            acc_new = acc * scale_old[..., None] + bacc * scale_new[..., None]
+            k_next = jax.lax.ppermute(k_cur, seq_axis, perm)
+            v_next = jax.lax.ppermute(v_cur, seq_axis, perm)
+            return (m_new, l_new, acc_new, k_next, v_next), None
+
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            step, (m0, l0, a0, k_blk, v_blk), jnp.arange(n_seq))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l_safe[..., None]).astype(q_blk.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

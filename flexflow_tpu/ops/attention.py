@@ -96,7 +96,13 @@ class MultiHeadAttentionOp(Op):
         v = jnp.einsum("bsd,dhk->bhsk", v_in, params["wv"])
         use_flash = self.attrs.get("use_flash", "auto")
         causal = self.attrs.get("causal", False)
-        if _should_use_flash(use_flash, q):
+        seq_axis = self.attrs.get("sequence_parallel_axis")
+        if seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
+            from ..kernels.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
+                                 causal=causal)
+        elif _should_use_flash(use_flash, q):
             from ..kernels.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal)

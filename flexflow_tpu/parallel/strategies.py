@@ -120,6 +120,32 @@ def _transitive_producer(pcg: PCG, node) -> Optional[int]:
     return None
 
 
+def long_context_strategy(pcg: PCG, dp: int, sp: int,
+                          data_axis: str = "data",
+                          seq_axis: str = "seq") -> Strategy:
+    """Sequence/context parallelism: activations sharded over the seq dim,
+    attention computed with ring attention over the ``seq`` mesh axis
+    (kernels/ring_attention.py). No reference analog (SURVEY §5) — the
+    long-context extension the reference lacks."""
+    s = Strategy(mesh_shape=(dp, sp), axis_names=(data_axis, seq_axis),
+                 data_axis=data_axis)
+    view = MachineView(dim=(dp, sp), stride=(sp, 1))
+    for node in pcg.topo_order():
+        ns = s.for_node(node.guid)
+        ns.view = view
+        ot = node.op.op_type
+        if ot == OperatorType.OP_MULTIHEAD_ATTENTION:
+            ns.extra["sequence_parallel_axis"] = seq_axis
+            # output stays seq-sharded: (batch, seq, hidden)
+            ns.output_spec = (data_axis, seq_axis, None)
+        elif len(node.out_shapes[0]) >= 3 and \
+                node.out_shapes[0][1] % max(sp, 1) == 0:
+            # keep 3D activations sharded over seq between blocks
+            ndim = len(node.out_shapes[0])
+            ns.output_spec = (data_axis, seq_axis) + (None,) * (ndim - 2)
+    return s
+
+
 def expert_parallel_strategy(pcg: PCG, dp: int, ep: int,
                              data_axis: str = "data",
                              expert_axis: str = "expert") -> Strategy:

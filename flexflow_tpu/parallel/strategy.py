@@ -31,6 +31,9 @@ class NodeStrategy:
         default_factory=lambda: MachineView(dim=(1,)))
     weight_specs: Dict[str, SpecT] = dataclasses.field(default_factory=dict)
     output_spec: Optional[SpecT] = None  # constraint on output 0
+    # op-level overrides applied at lowering (e.g. sequence_parallel_axis for
+    # ring attention); merged into the op's attrs by the Executor
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -63,6 +66,8 @@ class Strategy:
                          "start": ns.view.start_device_id},
                 "weight_specs": {k: list(v) for k, v in ns.weight_specs.items()},
                 "output_spec": list(ns.output_spec) if ns.output_spec else None,
+                "extra": {k: v for k, v in ns.extra.items()
+                          if isinstance(v, (str, int, float, bool))},
             }
         return json.dumps(out, indent=2)
 
@@ -83,7 +88,8 @@ class Strategy:
                 weight_specs={k: _despec(x) for k, x in
                               nd.get("weight_specs", {}).items()},
                 output_spec=_despec(nd["output_spec"])
-                if nd.get("output_spec") else None)
+                if nd.get("output_spec") else None,
+                extra=dict(nd.get("extra", {})))
             s.node_strategies[by_name[name]] = ns
         return s
 
