@@ -1,0 +1,3 @@
+"""ML frontends (SURVEY §2.4): torch-fx importer, Keras clone, ONNX importer."""
+from .torch_fx import PyTorchModel, copy_torch_weights  # noqa: F401
+from . import keras  # noqa: F401

@@ -1,0 +1,328 @@
+"""Keras frontend: tf.keras-style Sequential/functional API over FFModel.
+
+Rebuild of the reference's Keras clone (python/flexflow/keras/: Sequential and
+functional Model whose ``compile`` builds an FFModel + optimizer and ``fit``
+drives the training loop — models/base_model.py:128,198; layer classes under
+keras/layers/). Compact single-module version with the same user surface:
+string loss/metric/optimizer names resolve exactly like the reference's
+losses.py/metrics.py/optimizers.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import FFConfig
+from ..ffconst import ActiMode, AggrMode, DataType, LossType, MetricsType, PoolType
+from ..model import FFModel
+from ..execution.optimizers import AdamOptimizer, SGDOptimizer
+
+_LOSS_MAP = {
+    "categorical_crossentropy": LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+_METRIC_MAP = {
+    "accuracy": MetricsType.METRICS_ACCURACY,
+    "categorical_crossentropy": MetricsType.METRICS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "mse": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
+}
+_ACTI_MAP = {
+    None: ActiMode.AC_MODE_NONE, "linear": ActiMode.AC_MODE_NONE,
+    "relu": ActiMode.AC_MODE_RELU, "sigmoid": ActiMode.AC_MODE_SIGMOID,
+    "tanh": ActiMode.AC_MODE_TANH, "gelu": ActiMode.AC_MODE_GELU,
+}
+
+
+class Layer:
+    name_counter = 0
+
+    def __init__(self, name: Optional[str] = None):
+        type(self).name_counter += 1
+        self.name = name or f"{type(self).__name__.lower()}_{type(self).name_counter}"
+
+    def __call__(self, prev):
+        """Functional composition: returns a _Node."""
+        if isinstance(prev, (list, tuple)):
+            return _Node(self, list(prev))
+        return _Node(self, [prev])
+
+    def apply(self, ff: FFModel, inputs):
+        raise NotImplementedError
+
+
+class _Node:
+    def __init__(self, layer: Layer, inputs: List["_Node"]):
+        self.layer = layer
+        self.inputs = inputs
+
+
+class Input(Layer):
+    def __init__(self, shape: Sequence[int], dtype: str = "float32",
+                 name=None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __call__(self, *a, **k):  # Input is a source, already a node
+        raise TypeError("Input is not callable")
+
+
+def InputTensor(shape, dtype="float32", name=None) -> _Node:
+    layer = Input(shape, dtype, name)
+    return _Node(layer, [])
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 name=None, **kw):
+        super().__init__(name)
+        self.units = units
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def apply(self, ff, inputs):
+        return ff.dense(inputs[0], self.units, _ACTI_MAP[self.activation],
+                        self.use_bias, name=self.name)
+
+
+class Conv2D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, groups: int = 1,
+                 use_bias: bool = True, name=None, **kw):
+        super().__init__(name)
+        self.filters = filters
+        ks = kernel_size if isinstance(kernel_size, (tuple, list)) else \
+            (kernel_size, kernel_size)
+        st = strides if isinstance(strides, (tuple, list)) else \
+            (strides, strides)
+        self.kernel_size, self.strides = tuple(ks), tuple(st)
+        self.padding = padding
+        self.activation = activation
+        self.groups = groups
+        self.use_bias = use_bias
+
+    def apply(self, ff, inputs):
+        kh, kw_ = self.kernel_size
+        if self.padding == "same":
+            ph, pw = kh // 2, kw_ // 2
+        elif self.padding == "valid":
+            ph, pw = 0, 0
+        else:
+            ph, pw = self.padding
+        return ff.conv2d(inputs[0], self.filters, kh, kw_, self.strides[0],
+                         self.strides[1], ph, pw, _ACTI_MAP[self.activation],
+                         self.groups, self.use_bias, name=self.name)
+
+
+class _Pool2D(Layer):
+    pool_type = PoolType.POOL_MAX
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name=None):
+        super().__init__(name)
+        ps = pool_size if isinstance(pool_size, (tuple, list)) else \
+            (pool_size, pool_size)
+        self.pool_size = tuple(ps)
+        self.strides = tuple(strides) if strides else self.pool_size
+        self.padding = padding
+
+    def apply(self, ff, inputs):
+        ph = self.pool_size[0] // 2 if self.padding == "same" else 0
+        pw = self.pool_size[1] // 2 if self.padding == "same" else 0
+        return ff.pool2d(inputs[0], self.pool_size[0], self.pool_size[1],
+                         self.strides[0], self.strides[1], ph, pw,
+                         self.pool_type, name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = PoolType.POOL_MAX
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = PoolType.POOL_AVG
+
+
+class Flatten(Layer):
+    def apply(self, ff, inputs):
+        return ff.flat(inputs[0], name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, activation: str, name=None):
+        super().__init__(name)
+        self.activation = activation
+
+    def apply(self, ff, inputs):
+        x = inputs[0]
+        if self.activation == "softmax":
+            return ff.softmax(x, name=self.name)
+        fn = {"relu": ff.relu, "sigmoid": ff.sigmoid, "tanh": ff.tanh,
+              "gelu": ff.gelu, "elu": ff.elu}[self.activation]
+        return fn(x, name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def apply(self, ff, inputs):
+        return ff.dropout(inputs[0], self.rate, name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, name=None, **kw):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def apply(self, ff, inputs):
+        return ff.embedding(inputs[0], self.input_dim, self.output_dim,
+                            AggrMode.AGGR_MODE_NONE, name=self.name)
+
+
+class BatchNormalization(Layer):
+    def apply(self, ff, inputs):
+        return ff.batch_norm(inputs[0], relu=False, name=self.name)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, axis=-1, epsilon=1e-5, name=None):
+        super().__init__(name)
+        self.axis = axis if isinstance(axis, (list, tuple)) else [axis]
+        self.epsilon = epsilon
+
+    def apply(self, ff, inputs):
+        return ff.layer_norm(inputs[0], axes=list(self.axis),
+                             eps=self.epsilon, name=self.name)
+
+
+class Concatenate(Layer):
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, ff, inputs):
+        return ff.concat(list(inputs), axis=self.axis, name=self.name)
+
+
+class Add(Layer):
+    def apply(self, ff, inputs):
+        return ff.add(inputs[0], inputs[1], name=self.name)
+
+
+class Subtract(Layer):
+    def apply(self, ff, inputs):
+        return ff.subtract(inputs[0], inputs[1], name=self.name)
+
+
+class Multiply(Layer):
+    def apply(self, ff, inputs):
+        return ff.multiply(inputs[0], inputs[1], name=self.name)
+
+
+# --------------------------------------------------------------------- models
+class _BaseModel:
+    """reference: python/flexflow/keras/models/base_model.py."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.ffmodel: Optional[FFModel] = None
+        self.ffconfig = FFConfig()
+
+    def _resolve_optimizer(self, optimizer):
+        if isinstance(optimizer, str):
+            return {"sgd": SGDOptimizer(None, lr=0.01),
+                    "adam": AdamOptimizer(None)}[optimizer.lower()]
+        if isinstance(optimizer, dict):  # keras config dict
+            name = optimizer.get("class_name", "SGD").lower()
+            cfg = optimizer.get("config", {})
+            if name == "sgd":
+                return SGDOptimizer(None, lr=cfg.get("learning_rate", 0.01),
+                                    momentum=cfg.get("momentum", 0.0),
+                                    nesterov=cfg.get("nesterov", False))
+            return AdamOptimizer(None, alpha=cfg.get("learning_rate", 1e-3))
+        return optimizer
+
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics=("accuracy",), **kw):
+        """reference: base_model.py:128 — builds FFModel and compiles."""
+        ff = FFModel(self.ffconfig)
+        self._build(ff)
+        ff.compile(optimizer=self._resolve_optimizer(optimizer),
+                   loss_type=_LOSS_MAP[loss],
+                   metrics=[_METRIC_MAP[m] for m in metrics])
+        self.ffmodel = ff
+
+    def fit(self, x, y, batch_size: Optional[int] = None,
+            epochs: int = 1, callbacks=None, **kw):
+        """reference: base_model.py:198."""
+        assert self.ffmodel is not None, "compile the model first"
+        return self.ffmodel.fit(x, y, batch_size=batch_size, epochs=epochs)
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        return self.ffmodel.eval(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        return self.ffmodel.predict(x, batch_size=batch_size)
+
+    def _build(self, ff: FFModel) -> None:
+        raise NotImplementedError
+
+
+class Sequential(_BaseModel):
+    def __init__(self, layers: Optional[List[Layer]] = None, name=None):
+        super().__init__(name)
+        self.layers: List[Layer] = list(layers or [])
+
+    def add(self, layer: Layer) -> None:
+        self.layers.append(layer)
+
+    def _build(self, ff: FFModel) -> None:
+        assert isinstance(self.layers[0], Input), \
+            "first layer must be Input(shape=...)"
+        inp = self.layers[0]
+        dtype = DataType.DT_INT32 if "int" in inp.dtype else DataType.DT_FLOAT
+        t = ff.create_tensor((self.ffconfig.batch_size,) + inp.shape, dtype)
+        for layer in self.layers[1:]:
+            t = layer.apply(ff, [t])
+
+
+class Model(_BaseModel):
+    """Functional API: Model(inputs=[node...], outputs=node)."""
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name)
+        self.inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.outputs = outputs if isinstance(outputs, (list, tuple)) else \
+            [outputs]
+
+    def _build(self, ff: FFModel) -> None:
+        built: Dict[int, Any] = {}
+
+        def build_node(node: _Node):
+            if id(node) in built:
+                return built[id(node)]
+            if isinstance(node.layer, Input):
+                inp = node.layer
+                dtype = DataType.DT_INT32 if "int" in inp.dtype else \
+                    DataType.DT_FLOAT
+                t = ff.create_tensor(
+                    (self.ffconfig.batch_size,) + inp.shape, dtype)
+            else:
+                ins = [build_node(i) for i in node.inputs]
+                t = node.layer.apply(ff, ins)
+            built[id(node)] = t
+            return t
+
+        for out in self.outputs:
+            build_node(out)

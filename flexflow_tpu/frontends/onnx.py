@@ -1,0 +1,122 @@
+"""ONNX frontend: onnx protobuf graph -> FFModel builder calls.
+
+Rebuild of the reference's ONNX importer (python/flexflow/onnx/model.py:57-375,
+``ONNXModel.apply`` walking graph.node and dispatching per op_type). Gated on
+the ``onnx`` package (not baked into every image); raises a clear error when
+absent.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..ffconst import ActiMode, DataType, PoolType
+from ..model import FFModel
+from ..tensor import Tensor
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return onnx
+    except ImportError as e:
+        raise ImportError(
+            "the onnx package is required for the ONNX frontend; "
+            "install onnx or use the torch/keras frontends") from e
+
+
+class ONNXModel:
+    """reference: python/flexflow/onnx/model.py:57."""
+
+    def __init__(self, filename_or_model):
+        onnx = _require_onnx()
+        if isinstance(filename_or_model, str):
+            self.model = onnx.load(filename_or_model)
+        else:
+            self.model = filename_or_model
+        self.inputs: Dict[str, Any] = {}
+        self.initializers: Dict[str, np.ndarray] = {}
+
+    def apply(self, ffmodel: FFModel, input_tensors: Dict[str, Tensor]):
+        onnx = _require_onnx()
+        from onnx import numpy_helper
+
+        env: Dict[str, Any] = dict(input_tensors)
+        for init in self.model.graph.initializer:
+            self.initializers[init.name] = numpy_helper.to_array(init)
+
+        def attr(node, name, default=None):
+            for a in node.attribute:
+                if a.name == name:
+                    if a.type == onnx.AttributeProto.INT:
+                        return a.i
+                    if a.type == onnx.AttributeProto.INTS:
+                        return list(a.ints)
+                    if a.type == onnx.AttributeProto.FLOAT:
+                        return a.f
+                    if a.type == onnx.AttributeProto.STRING:
+                        return a.s.decode()
+            return default
+
+        for node in self.model.graph.node:
+            op = node.op_type
+            ins = [env.get(i) for i in node.input]
+            if op == "Gemm" or op == "MatMul":
+                w = self.initializers[node.input[1]]
+                out_dim = w.shape[1] if op == "MatMul" else (
+                    w.shape[0] if attr(node, "transB", 0) else w.shape[1])
+                use_bias = len(node.input) > 2
+                t = ffmodel.dense(ins[0], int(out_dim), use_bias=use_bias)
+            elif op == "Conv":
+                w = self.initializers[node.input[1]]
+                kh, kw = attr(node, "kernel_shape", [w.shape[2], w.shape[3]])
+                st = attr(node, "strides", [1, 1])
+                pads = attr(node, "pads", [0, 0, 0, 0])
+                t = ffmodel.conv2d(ins[0], int(w.shape[0]), kh, kw, st[0],
+                                   st[1], pads[0], pads[1],
+                                   groups=attr(node, "group", 1),
+                                   use_bias=len(node.input) > 2)
+            elif op == "MaxPool" or op == "AveragePool":
+                k = attr(node, "kernel_shape")
+                st = attr(node, "strides", k)
+                pads = attr(node, "pads", [0, 0, 0, 0])
+                pt = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
+                t = ffmodel.pool2d(ins[0], k[0], k[1], st[0], st[1], pads[0],
+                                   pads[1], pt)
+            elif op == "Relu":
+                t = ffmodel.relu(ins[0])
+            elif op == "Sigmoid":
+                t = ffmodel.sigmoid(ins[0])
+            elif op == "Tanh":
+                t = ffmodel.tanh(ins[0])
+            elif op == "Softmax":
+                t = ffmodel.softmax(ins[0], axis=attr(node, "axis", -1))
+            elif op == "Add":
+                t = ffmodel.add(ins[0], ins[1])
+            elif op == "Sub":
+                t = ffmodel.subtract(ins[0], ins[1])
+            elif op == "Mul":
+                t = ffmodel.multiply(ins[0], ins[1])
+            elif op == "Concat":
+                t = ffmodel.concat([i for i in ins if i is not None],
+                                   axis=attr(node, "axis", 1))
+            elif op == "Flatten":
+                t = ffmodel.flat(ins[0])
+            elif op == "Reshape":
+                shape = self.initializers[node.input[1]].tolist()
+                t = ffmodel.reshape(ins[0], shape)
+            elif op == "Transpose":
+                t = ffmodel.transpose(ins[0], attr(node, "perm"))
+            elif op == "Dropout":
+                t = ffmodel.dropout(ins[0], attr(node, "ratio", 0.5))
+            elif op == "ReduceMean":
+                t = ffmodel.mean(ins[0], dims=attr(node, "axes", [-1]),
+                                 keepdims=bool(attr(node, "keepdims", 1)))
+            elif op == "Cast" or op == "Identity":
+                t = ins[0]
+            else:
+                raise NotImplementedError(f"ONNX op {op}")
+            env[node.output[0]] = t
+        return [env[o.name] for o in self.model.graph.output]

@@ -1,0 +1,283 @@
+"""PyTorch frontend: torch.fx symbolic trace -> FFModel builder calls.
+
+Rebuild of the reference's torch frontend (python/flexflow/torch/model.py,
+2607 LoC): ``PyTorchModel`` traces an ``nn.Module`` with torch.fx (the
+reference also supports HuggingFace's symbolic trace, :2427) and walks the fx
+graph emitting FFModel ops (``torch_to_ff``, :2496). Weights are copied from
+the torch module so numerics match — the basis of the reference's strongest
+correctness tier, tests/align (SURVEY §4).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ffconst import ActiMode, AggrMode, DataType, PoolType
+from ..model import FFModel
+from ..tensor import Tensor
+
+
+class PyTorchModel:
+    """reference: python/flexflow/torch/model.py:2408."""
+
+    def __init__(self, module, is_hf_model: bool = False):
+        self.module = module
+        self.is_hf_model = is_hf_model
+
+    def torch_to_ff(self, ffmodel: FFModel, input_tensors: List[Tensor]
+                    ) -> List[Tensor]:
+        """Trace the module and emit FFModel ops; returns output tensors
+        (reference: torch_to_ff, model.py:2496)."""
+        import torch
+        import torch.fx as fx
+
+        if self.is_hf_model:
+            from transformers.utils.fx import symbolic_trace as hf_trace
+
+            traced = hf_trace(self.module)
+        else:
+            traced = fx.symbolic_trace(self.module)
+
+        env: Dict[str, Any] = {}
+        inputs = list(input_tensors)
+        outputs: List[Tensor] = []
+        modules = dict(traced.named_modules())
+
+        for node in traced.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = inputs.pop(0)
+            elif node.op == "call_module":
+                mod = modules[node.target]
+                env[node.name] = _convert_module(
+                    ffmodel, mod, _args(env, node.args), node.target)
+            elif node.op == "call_function" or node.op == "call_method":
+                env[node.name] = _convert_function(
+                    ffmodel, node, _args(env, node.args),
+                    {k: _lookup(env, v) for k, v in node.kwargs.items()})
+            elif node.op == "get_attr":
+                env[node.name] = _fetch_attr(self.module, node.target)
+            elif node.op == "output":
+                out = node.args[0]
+                if isinstance(out, (tuple, list)):
+                    outputs = [_lookup(env, o) for o in out]
+                else:
+                    outputs = [_lookup(env, out)]
+        return outputs
+
+    def apply(self, ffmodel: FFModel, input_tensors: List[Tensor]):
+        return self.torch_to_ff(ffmodel, input_tensors)
+
+
+def _args(env, args):
+    return [_lookup(env, a) for a in args]
+
+
+def _lookup(env, a):
+    import torch.fx as fx
+
+    if isinstance(a, fx.Node):
+        return env[a.name]
+    if isinstance(a, (tuple, list)):
+        return type(a)(_lookup(env, x) for x in a)
+    return a
+
+
+def _fetch_attr(module, target: str):
+    obj = module
+    for part in target.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _set_weight(ffmodel: FFModel, layer_out: Tensor, arrays: Dict[str, np.ndarray]):
+    """Stash torch weights for copy after compile()."""
+    layer = layer_out.owner_layer
+    pending = getattr(ffmodel, "_pending_torch_weights", None)
+    if pending is None:
+        pending = {}
+        ffmodel._pending_torch_weights = pending
+    pending[layer.name] = arrays
+
+
+def copy_torch_weights(ffmodel: FFModel) -> None:
+    """Copy traced-module weights into the compiled model's params (call after
+    ffmodel.compile)."""
+    import jax
+
+    pending = getattr(ffmodel, "_pending_torch_weights", {})
+    for lname, arrays in pending.items():
+        if lname not in ffmodel.params:
+            continue
+        for wname, arr in arrays.items():
+            cur = ffmodel.params[lname][wname]
+            arr = np.asarray(arr, dtype=np.asarray(cur).dtype)
+            assert arr.shape == cur.shape, (lname, wname, arr.shape, cur.shape)
+            ffmodel.params[lname][wname] = jax.device_put(arr, cur.sharding)
+
+
+def _convert_module(ffmodel: FFModel, mod, args, name: str):
+    import torch.nn as nn
+
+    name = name.replace(".", "_")
+    x = args[0]
+    if isinstance(mod, nn.Linear):
+        out = ffmodel.dense(x, mod.out_features, use_bias=mod.bias is not None,
+                            name=name)
+        w = {"kernel": _np(mod.weight).T}
+        if mod.bias is not None:
+            w["bias"] = _np(mod.bias)
+        _set_weight(ffmodel, out, w)
+        return out
+    if isinstance(mod, nn.Conv2d):
+        out = ffmodel.conv2d(
+            x, mod.out_channels, mod.kernel_size[0], mod.kernel_size[1],
+            mod.stride[0], mod.stride[1], mod.padding[0], mod.padding[1],
+            groups=mod.groups, use_bias=mod.bias is not None, name=name)
+        # torch OIHW -> HWIO
+        w = {"kernel": _np(mod.weight).transpose(2, 3, 1, 0)}
+        if mod.bias is not None:
+            w["bias"] = _np(mod.bias)
+        _set_weight(ffmodel, out, w)
+        return out
+    if isinstance(mod, nn.BatchNorm2d):
+        out = ffmodel.batch_norm(x, relu=False, name=name)
+        _set_weight(ffmodel, out, {"scale": _np(mod.weight),
+                                   "bias": _np(mod.bias)})
+        return out
+    if isinstance(mod, nn.LayerNorm):
+        axes = list(range(-len(mod.normalized_shape), 0))
+        out = ffmodel.layer_norm(x, axes=axes, eps=mod.eps, name=name)
+        if mod.elementwise_affine:
+            _set_weight(ffmodel, out, {"scale": _np(mod.weight),
+                                       "bias": _np(mod.bias)})
+        return out
+    if isinstance(mod, nn.Embedding):
+        out = ffmodel.embedding(x, mod.num_embeddings, mod.embedding_dim,
+                                AggrMode.AGGR_MODE_NONE, name=name)
+        _set_weight(ffmodel, out, {"weight": _np(mod.weight)})
+        return out
+    if isinstance(mod, nn.ReLU):
+        return ffmodel.relu(x, name=name)
+    if isinstance(mod, nn.GELU):
+        return ffmodel.gelu(x, name=name)
+    if isinstance(mod, nn.Sigmoid):
+        return ffmodel.sigmoid(x, name=name)
+    if isinstance(mod, nn.Tanh):
+        return ffmodel.tanh(x, name=name)
+    if isinstance(mod, nn.Softmax):
+        return ffmodel.softmax(x, axis=mod.dim if mod.dim is not None else -1,
+                               name=name)
+    if isinstance(mod, nn.Dropout):
+        return ffmodel.dropout(x, rate=mod.p, name=name)
+    if isinstance(mod, nn.MaxPool2d):
+        k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else \
+            (mod.kernel_size, mod.kernel_size)
+        st = mod.stride if isinstance(mod.stride, tuple) else \
+            (mod.stride or k[0], mod.stride or k[1])
+        p = mod.padding if isinstance(mod.padding, tuple) else \
+            (mod.padding, mod.padding)
+        return ffmodel.pool2d(x, k[0], k[1], st[0], st[1], p[0], p[1],
+                              PoolType.POOL_MAX, name=name)
+    if isinstance(mod, nn.AvgPool2d):
+        k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else \
+            (mod.kernel_size, mod.kernel_size)
+        st = mod.stride if isinstance(mod.stride, tuple) else \
+            (mod.stride or k[0], mod.stride or k[1])
+        p = mod.padding if isinstance(mod.padding, tuple) else \
+            (mod.padding, mod.padding)
+        return ffmodel.pool2d(x, k[0], k[1], st[0], st[1], p[0], p[1],
+                              PoolType.POOL_AVG, name=name)
+    if isinstance(mod, nn.Flatten):
+        return ffmodel.flat(x, name=name)
+    if isinstance(mod, nn.Identity):
+        return ffmodel.identity(x, name=name)
+    raise NotImplementedError(f"torch module {type(mod).__name__}")
+
+
+def _convert_function(ffmodel: FFModel, node, args, kwargs):
+    import operator
+
+    import torch
+    import torch.nn.functional as F
+
+    t = node.target
+    if node.op == "call_method":
+        x = args[0]
+        if t == "view" or t == "reshape":
+            shape = [a for a in args[1:]]
+            if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+                shape = list(shape[0])
+            return ffmodel.reshape(x, [s if isinstance(s, int) else -1
+                                       for s in shape])
+        if t == "permute":
+            return ffmodel.transpose(x, list(args[1:]))
+        if t == "transpose":
+            perm = list(range(len(x.dims)))
+            i, j = args[1], args[2]
+            perm[i], perm[j] = perm[j], perm[i]
+            return ffmodel.transpose(x, perm)
+        if t == "flatten":
+            return ffmodel.flat(x)
+        if t == "mean":
+            return ffmodel.mean(x, dims=[args[1]] if len(args) > 1 else [-1],
+                                keepdims=kwargs.get("keepdim", False))
+        if t == "contiguous" or t == "clone" or t == "detach":
+            return x
+        if t == "size" or t == "dim":
+            raise NotImplementedError("dynamic size() in traced graph")
+        raise NotImplementedError(f"torch method {t}")
+
+    if t in (operator.add, torch.add):
+        return _binary(ffmodel, "add", args)
+    if t in (operator.sub, torch.sub):
+        return _binary(ffmodel, "subtract", args)
+    if t in (operator.mul, torch.mul):
+        return _binary(ffmodel, "multiply", args)
+    if t in (operator.truediv, torch.div):
+        return _binary(ffmodel, "divide", args)
+    if t in (torch.matmul, torch.bmm):
+        return ffmodel.batch_matmul(args[0], args[1])
+    if t is F.relu or t is torch.relu:
+        return ffmodel.relu(args[0])
+    if t is F.gelu:
+        return ffmodel.gelu(args[0])
+    if t is torch.sigmoid or t is F.sigmoid:
+        return ffmodel.sigmoid(args[0])
+    if t is torch.tanh or t is F.tanh:
+        return ffmodel.tanh(args[0])
+    if t is F.softmax or t is torch.softmax:
+        axis = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+        return ffmodel.softmax(args[0], axis=axis)
+    if t is torch.cat:
+        tensors = args[0]
+        axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+        return ffmodel.concat(list(tensors), axis=axis)
+    if t is torch.flatten:
+        return ffmodel.flat(args[0])
+    if t is torch.mean:
+        dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+        if dims is None:
+            raise NotImplementedError("full-reduce mean")
+        dims = [dims] if isinstance(dims, int) else list(dims)
+        return ffmodel.mean(args[0], dims=dims,
+                            keepdims=kwargs.get("keepdim", False))
+    if t is F.dropout:
+        return ffmodel.dropout(args[0], rate=kwargs.get("p", 0.5))
+    if t is getattr(torch, "pow", None) or t is operator.pow:
+        return ffmodel.pow(args[0], args[1])
+    raise NotImplementedError(f"torch function {t}")
+
+
+def _binary(ffmodel: FFModel, opname: str, args):
+    a, b = args[0], args[1]
+    if isinstance(b, (int, float)):
+        scalar_map = {"add": "scalar_add", "subtract": "scalar_sub",
+                      "multiply": "scalar_multiply",
+                      "divide": "scalar_true_divide"}
+        return getattr(ffmodel, scalar_map[opname])(a, float(b))
+    return getattr(ffmodel, opname)(a, b)

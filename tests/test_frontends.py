@@ -1,0 +1,128 @@
+"""Frontend tests. The torch-fx alignment test is the port of the reference's
+tests/align protocol (SURVEY §4): run the same model in torch and in the
+framework, compare forward outputs numerically."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+
+torch = pytest.importorskip("torch")
+
+
+class TorchMLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(16, 32)
+        self.act = torch.nn.ReLU()
+        self.ln = torch.nn.LayerNorm(32)
+        self.fc2 = torch.nn.Linear(32, 4)
+
+    def forward(self, x):
+        h = self.act(self.fc1(x))
+        h = self.ln(h)
+        return self.fc2(h) + 1.0
+
+
+class TorchConvNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(3, 8, 3, padding=1)
+        self.bnless_pool = torch.nn.MaxPool2d(2)
+        self.flat = torch.nn.Flatten()
+        self.fc = torch.nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        h = torch.relu(self.conv(x))
+        h = self.bnless_pool(h)
+        return self.fc(self.flat(h))
+
+
+def _align(module, in_shape, batch=4, atol=1e-4):
+    """Build both, copy weights, compare forward outputs (tests/align)."""
+    from flexflow_tpu.frontends.torch_fx import (PyTorchModel,
+                                                 copy_torch_weights)
+
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x_t = ff.create_tensor((batch,) + in_shape)
+    pt = PyTorchModel(module)
+    outs = pt.torch_to_ff(ff, [x_t])
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    copy_torch_weights(ff)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch,) + in_shape).astype(np.float32)
+    with torch.no_grad():
+        ref = module(torch.from_numpy(x)).numpy()
+    got = ff.predict(x, batch_size=batch)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=atol)
+    return outs
+
+
+def test_torch_mlp_alignment():
+    _align(TorchMLP().eval(), (16,))
+
+
+def test_torch_convnet_alignment():
+    _align(TorchConvNet().eval(), (3, 8, 8))
+
+
+def test_keras_sequential():
+    from flexflow_tpu.frontends import keras as K
+
+    model = K.Sequential([
+        K.Input(shape=(20,)),
+        K.Dense(32, activation="relu"),
+        K.Dropout(0.1),
+        K.Dense(4),
+        K.Activation("softmax"),
+    ])
+    model.ffconfig.batch_size = 16
+    model.ffconfig.epochs = 3
+    model.compile(optimizer={"class_name": "Adam",
+                             "config": {"learning_rate": 0.01}},
+                  loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(20, 4))
+    x = rng.normal(size=(64, 20)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    model.fit(x, y, epochs=20)
+    perf = model.evaluate(x, y)
+    assert perf.accuracy() > 0.6
+
+
+def test_keras_functional():
+    from flexflow_tpu.frontends import keras as K
+
+    a = K.InputTensor(shape=(8,))
+    b = K.InputTensor(shape=(8,))
+    ha = K.Dense(16, activation="relu")(a)
+    hb = K.Dense(16, activation="relu")(b)
+    merged = K.Concatenate(axis=1)([ha, hb])
+    out = K.Activation("softmax")(K.Dense(3)(merged))
+    model = K.Model(inputs=[a, b], outputs=out)
+    model.ffconfig.batch_size = 8
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=(32, 8)).astype(np.float32)
+    x2 = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 3, size=32).astype(np.int32)
+    model.fit([x1, x2], y, epochs=1)
+
+
+def test_onnx_gated():
+    """The ONNX frontend either imports onnx or raises a clear error."""
+    try:
+        import onnx  # noqa: F401
+
+        have = True
+    except ImportError:
+        have = False
+    if not have:
+        from flexflow_tpu.frontends.onnx import ONNXModel
+
+        with pytest.raises(ImportError, match="onnx package is required"):
+            ONNXModel("nonexistent.onnx")
