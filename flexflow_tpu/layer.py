@@ -26,12 +26,15 @@ class Layer:
         numWeights: int = 0,
         numOutputs: int = 1,
         attrs: Optional[Dict[str, Any]] = None,
+        index: Optional[int] = None,
     ):
         self.guid = next(_layer_guid)
         self.op_type = op_type
         self.data_type = dtype
         base = name or op_type.name.lower().replace("op_", "")
-        self.name = f"{base}_{self.guid}"
+        # deterministic per-model naming (index = position in the model) so
+        # checkpoints/strategies transfer between identical models
+        self.name = f"{base}_{self.guid if index is None else index}"
         self.inputs: List[Tensor] = list(inputs)
         self.outputs: List[Tensor] = []
         self.num_weights = numWeights

@@ -71,7 +71,8 @@ class FFModel:
         from .ops.base import op_class_for
 
         dtype = dtype or (inputs[0].dtype if inputs else DataType.DT_FLOAT)
-        layer = Layer(op_type, dtype, name, inputs, attrs=attrs)
+        layer = Layer(op_type, dtype, name, inputs, attrs=attrs,
+                      index=len(self._layers))
         op = op_class_for(op_type)(layer.name, attrs, dtype,
                                    num_inputs=len(inputs))
         out_shapes = op.infer_output_shapes([t.dims for t in inputs])
@@ -644,6 +645,9 @@ class FFModel:
     def recompile_on_condition(self, recompile_state) -> bool:
         if recompile_state.trigger():
             recompile_state.alter(self)
+            from .execution.recompile import recompile
+
+            recompile(self)
             return True
         return False
 

