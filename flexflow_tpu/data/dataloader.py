@@ -54,6 +54,13 @@ def batch_iterator(arrays: Sequence[np.ndarray], batch_size: int,
     if shuffle:
         np.random.default_rng(seed).shuffle(idx)
     nb = n // batch_size if drop_remainder else -(-n // batch_size)
+    if shuffle:
+        from ..native import gather_rows  # native multithreaded gather
+
+        for b in range(nb):
+            sl = idx[b * batch_size:(b + 1) * batch_size]
+            yield [gather_rows(a, sl) for a in arrays]
+        return
     for b in range(nb):
         sl = idx[b * batch_size:(b + 1) * batch_size]
         yield [a[sl] for a in arrays]

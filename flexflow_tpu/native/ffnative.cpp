@@ -1,0 +1,115 @@
+// Native runtime core for flexflow_tpu.
+//
+// TPU-native equivalents of the reference's host-side C++ runtime pieces:
+//  * gather_rows: multithreaded batch gather/staging — the hot loop of the
+//    dataloader (reference: python/flexflow_dataloader.cc:574, which stages
+//    batches from zero-copy memory with index-launched copies; here the
+//    host-side gather feeding jax.device_put).
+//  * simulate_taskgraph: event-driven list-scheduling simulation of a task
+//    graph with per-task costs and dependency edges — the inner loop of the
+//    strategy simulator (reference: Simulator::simulate_runtime,
+//    src/runtime/simulator.cc:815), called thousands of times by the search.
+//
+// Built as a plain shared library, loaded via ctypes (no pybind11 in image).
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows from src into dst: dst[i] = src[indices[i]] for row_bytes-sized
+// rows. Multithreaded memcpy; returns 0 on success.
+int gather_rows(const void* src, const int64_t* indices, void* dst,
+                int64_t n_rows, int64_t row_bytes, int n_threads) {
+  if (!src || !dst || !indices || n_rows < 0 || row_bytes <= 0) return -1;
+  if (n_threads <= 0) n_threads = 1;
+  n_threads = std::min<int64_t>(n_threads, std::max<int64_t>(n_rows, 1));
+  const char* s = static_cast<const char*>(src);
+  char* d = static_cast<char*>(dst);
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(d + i * row_bytes, s + indices[i] * row_bytes, row_bytes);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n_rows);
+    return 0;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n_rows);
+    if (lo >= hi) break;
+    threads.emplace_back(worker, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+// Event-driven simulation of a task graph (list scheduling).
+//   n_tasks: number of tasks; costs[i]: execution time of task i
+//   device[i]: device id executing task i (tasks on one device serialize)
+//   n_edges edges src[e] -> dst[e] (dst depends on src)
+// Returns the makespan, or -1 on error (e.g. cycle).
+double simulate_taskgraph(int64_t n_tasks, const double* costs,
+                          const int32_t* device, int32_t n_devices,
+                          int64_t n_edges, const int32_t* esrc,
+                          const int32_t* edst) {
+  if (n_tasks <= 0) return 0.0;
+  if (!costs || !device || n_devices <= 0) return -1.0;
+  std::vector<std::vector<int32_t>> out(n_tasks);
+  std::vector<int32_t> indeg(n_tasks, 0);
+  for (int64_t e = 0; e < n_edges; ++e) {
+    if (esrc[e] < 0 || esrc[e] >= n_tasks || edst[e] < 0 ||
+        edst[e] >= n_tasks)
+      return -1.0;
+    out[esrc[e]].push_back(edst[e]);
+    indeg[edst[e]]++;
+  }
+  // ready time per task (dependency-driven), busy-until per device
+  std::vector<double> ready(n_tasks, 0.0);
+  std::vector<double> dev_free(n_devices, 0.0);
+  // priority queue of (ready_time, task) over tasks with indeg 0
+  using QE = std::pair<double, int32_t>;
+  std::priority_queue<QE, std::vector<QE>, std::greater<QE>> q;
+  for (int64_t i = 0; i < n_tasks; ++i)
+    if (indeg[i] == 0) q.emplace(0.0, (int32_t)i);
+  double makespan = 0.0;
+  int64_t done = 0;
+  while (!q.empty()) {
+    auto [rt, t] = q.top();
+    q.pop();
+    int32_t dev = device[t] % n_devices;
+    double start = std::max(rt, dev_free[dev]);
+    double finish = start + costs[t];
+    dev_free[dev] = finish;
+    makespan = std::max(makespan, finish);
+    ++done;
+    for (int32_t c : out[t]) {
+      ready[c] = std::max(ready[c], finish);
+      if (--indeg[c] == 0) q.emplace(ready[c], c);
+    }
+  }
+  if (done != n_tasks) return -1.0;  // cycle
+  return makespan;
+}
+
+// Structural FNV-1a hash over a byte buffer — used for fast PCG hashing in
+// the search (reference: Graph::hash over op params).
+uint64_t fnv1a_hash(const void* data, int64_t n_bytes) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < n_bytes; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+}  // extern "C"

@@ -187,6 +187,69 @@ class Simulator:
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
         return total_compute + total_comm + total_sync, mem
 
+    def simulate_event_driven(self, pcg: PCG,
+                              assignment: Dict[int, OpSharding],
+                              states: Optional[Dict[int, str]] = None
+                              ) -> float:
+        """Event-driven makespan via the native task-graph core
+        (reference: simulate_runtime's per-device timelines). Two logical
+        execution units per chip: the compute stream (0) and the async
+        collective/DMA stream (1) — collectives overlap independent compute,
+        which the additive model in simulate() cannot express."""
+        from ..native import simulate_taskgraph
+
+        states = states or {}
+        nodes = pcg.compute_nodes()
+        idx = {}
+        costs: List[float] = []
+        devs: List[int] = []
+        esrc: List[int] = []
+        edst: List[int] = []
+
+        def add_task(cost: float, dev: int) -> int:
+            costs.append(cost)
+            devs.append(dev)
+            return len(costs) - 1
+
+        for node in nodes:
+            sh = assignment.get(node.guid, OpSharding())
+            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+            cm = self.op_cost(node, in_shapes, sh)
+            fwd = add_task(cm.forward_time, 0)
+            idx[node.guid] = fwd
+            if cm.comm_time > 0:
+                comm = add_task(cm.comm_time, 1)
+                esrc.append(fwd)
+                edst.append(comm)
+                idx[node.guid] = comm  # consumers wait for the collective
+            for g, _ in node.inputs:
+                if g in idx:
+                    esrc.append(idx[g])
+                    edst.append(fwd)
+        # backward + sync: mirror the forward chain; grad allreduces go on the
+        # collective stream and overlap the rest of the backward pass
+        bwd_prev = None
+        for node in reversed(nodes):
+            sh = assignment.get(node.guid, OpSharding())
+            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+            cm = self.op_cost(node, in_shapes, sh)
+            bwd = add_task(cm.backward_time, 0)
+            if bwd_prev is not None:
+                esrc.append(bwd_prev)
+                edst.append(bwd)
+            else:
+                esrc.append(idx[nodes[-1].guid])
+                edst.append(bwd)
+            bwd_prev = bwd
+            if cm.sync_time > 0:
+                sync = add_task(cm.sync_time, 1)
+                esrc.append(bwd)
+                edst.append(sync)
+        return simulate_taskgraph(
+            np.asarray(costs), np.asarray(devs), 2,
+            np.asarray(esrc, dtype=np.int32),
+            np.asarray(edst, dtype=np.int32))
+
     # -------------------------------------------- measured mode (on device)
     def measure_operator_cost(self, node: PCGNode,
                               in_shapes: List[Tuple[int, ...]],
