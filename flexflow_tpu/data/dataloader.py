@@ -52,18 +52,18 @@ def batch_iterator(arrays: Sequence[np.ndarray], batch_size: int,
     n = arrays[0].shape[0]
     idx = np.arange(n)
     if shuffle:
-        np.random.default_rng(seed).shuffle(idx)
-    nb = n // batch_size if drop_remainder else -(-n // batch_size)
-    if shuffle:
         from ..native import gather_rows  # native multithreaded gather
 
-        for b in range(nb):
-            sl = idx[b * batch_size:(b + 1) * batch_size]
-            yield [gather_rows(a, sl) for a in arrays]
-        return
+        np.random.default_rng(seed).shuffle(idx)
+        arrays = [np.ascontiguousarray(a) for a in arrays]  # once, not per batch
+        take = gather_rows
+    else:
+        def take(a, sl):
+            return a[sl]
+    nb = n // batch_size if drop_remainder else -(-n // batch_size)
     for b in range(nb):
         sl = idx[b * batch_size:(b + 1) * batch_size]
-        yield [a[sl] for a in arrays]
+        yield [take(a, sl) for a in arrays]
 
 
 def device_put_batch(arrays: List[np.ndarray], shardings: List[Any]):

@@ -64,8 +64,6 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
-        lib.fnv1a_hash.restype = ctypes.c_uint64
-        lib.fnv1a_hash.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -76,6 +74,15 @@ def gather_rows(src: np.ndarray, indices: np.ndarray,
     fallback (the dataloader's shuffled-batch staging hot loop)."""
     src = np.ascontiguousarray(src)
     idx = np.ascontiguousarray(indices, dtype=np.int64)
+    n = src.shape[0]
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < -n or hi >= n:
+            raise IndexError(
+                f"gather_rows: index out of range for {n} rows "
+                f"(min {lo}, max {hi})")
+        if lo < 0:  # numpy negative-index semantics on both paths
+            idx = np.where(idx < 0, idx + n, idx)
     lib = get_lib()
     if lib is None:
         return src[idx]
@@ -108,8 +115,11 @@ def simulate_taskgraph(costs: np.ndarray, device: np.ndarray,
             n_devices, len(esrc),
             esrc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             edst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-        if r >= 0:
-            return float(r)
+        if r < 0:
+            raise ValueError(
+                "simulate_taskgraph: invalid task graph "
+                "(cycle, bad edge, or device id out of range)")
+        return float(r)
     return _simulate_py(costs, device, n_devices, esrc, edst)
 
 
@@ -122,21 +132,27 @@ def _simulate_py(costs, device, n_devices, esrc, edst) -> float:
     for s, d in zip(esrc, edst):
         out[s].append(int(d))
         indeg[d] += 1
+    if any(int(d) < 0 or int(d) >= n_devices for d in device):
+        raise ValueError("simulate_taskgraph: device id out of range")
     ready = [0.0] * n
     dev_free = [0.0] * max(n_devices, 1)
     q = [(0.0, i) for i in range(n) if indeg[i] == 0]
     heapq.heapify(q)
     makespan = 0.0
+    done = 0
     while q:
         rt, t = heapq.heappop(q)
-        dev = int(device[t]) % n_devices
+        dev = int(device[t])
         start = max(rt, dev_free[dev])
         finish = start + float(costs[t])
         dev_free[dev] = finish
         makespan = max(makespan, finish)
+        done += 1
         for c in out[t]:
             ready[c] = max(ready[c], finish)
             indeg[c] -= 1
             if indeg[c] == 0:
                 heapq.heappush(q, (ready[c], c))
+    if done != n:
+        raise ValueError("simulate_taskgraph: task graph has a cycle")
     return makespan

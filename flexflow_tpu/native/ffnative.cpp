@@ -63,6 +63,8 @@ double simulate_taskgraph(int64_t n_tasks, const double* costs,
                           const int32_t* edst) {
   if (n_tasks <= 0) return 0.0;
   if (!costs || !device || n_devices <= 0) return -1.0;
+  for (int64_t i = 0; i < n_tasks; ++i)
+    if (device[i] < 0 || device[i] >= n_devices) return -1.0;
   std::vector<std::vector<int32_t>> out(n_tasks);
   std::vector<int32_t> indeg(n_tasks, 0);
   for (int64_t e = 0; e < n_edges; ++e) {
@@ -85,7 +87,7 @@ double simulate_taskgraph(int64_t n_tasks, const double* costs,
   while (!q.empty()) {
     auto [rt, t] = q.top();
     q.pop();
-    int32_t dev = device[t] % n_devices;
+    int32_t dev = device[t];
     double start = std::max(rt, dev_free[dev]);
     double finish = start + costs[t];
     dev_free[dev] = finish;
@@ -98,18 +100,6 @@ double simulate_taskgraph(int64_t n_tasks, const double* costs,
   }
   if (done != n_tasks) return -1.0;  // cycle
   return makespan;
-}
-
-// Structural FNV-1a hash over a byte buffer — used for fast PCG hashing in
-// the search (reference: Graph::hash over op params).
-uint64_t fnv1a_hash(const void* data, int64_t n_bytes) {
-  const unsigned char* p = static_cast<const unsigned char*>(data);
-  uint64_t h = 1469598103934665603ULL;
-  for (int64_t i = 0; i < n_bytes; ++i) {
-    h ^= p[i];
-    h *= 1099511628211ULL;
-  }
-  return h;
 }
 
 }  // extern "C"

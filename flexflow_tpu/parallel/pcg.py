@@ -111,6 +111,58 @@ class PCG:
         g._order = list(self._order)
         return g
 
+    # -- search-time splitting (reference: Graph::split_at_node,
+    # src/runtime/graph.cc:958) -------------------------------------------------
+    def split_at_node(self, guid: int) -> Tuple["PCG", "PCG"]:
+        """Split into (pre, post) subgraphs at a bottleneck node: ``pre``
+        contains the node and everything it depends on; ``post`` contains
+        the rest, with the bottleneck's producers re-rooted as inputs."""
+        assert guid in self.nodes, guid
+        anc: set = set()
+        stack = [guid]
+        while stack:
+            g = stack.pop()
+            if g in anc:
+                continue
+            anc.add(g)
+            stack.extend(pg for pg, _ in self.nodes[g].inputs)
+        pre, post = PCG(), PCG()
+        for g in self._order:
+            n = self.nodes[g]
+            target = pre if g in anc else post
+            target.nodes[g] = dataclasses.replace(
+                n, inputs=list(n.inputs), out_shapes=list(n.out_shapes),
+                out_dtypes=list(n.out_dtypes))
+            target._order.append(g)
+        # post-side consumers of pre-side nodes keep the guid reference;
+        # materialize those producers as input placeholders in `post`
+        from ..ops.noop import InputOp
+
+        needed = {pg for g in post._order for pg, _ in post.nodes[g].inputs
+                  if pg in anc}
+        for pg in sorted(needed):
+            src = self.nodes[pg]
+            op = InputOp(name=f"split_in_{pg}",
+                         attrs={"shape": src.out_shapes[0],
+                                "dtype": src.out_dtypes[0]},
+                         dtype=src.out_dtypes[0], num_inputs=0)
+            node = PCGNode(guid=pg, op=op, inputs=[],
+                           out_shapes=list(src.out_shapes),
+                           out_dtypes=list(src.out_dtypes))
+            post.nodes[pg] = node
+            post._order.insert(0, pg)
+        return pre, post
+
+    def bottlenecks(self) -> List[int]:
+        """Compute-node guids every source-to-sink path passes through
+        (reference: find_bottleneck_node via imm_post_dominators,
+        graph.cc:610-623)."""
+        from ..utils.graph_utils import find_bottlenecks, pcg_basic_graph
+
+        g = pcg_basic_graph(self)
+        sinks = set(x.guid for x in self.sinks())
+        return [b for b in find_bottlenecks(g) if b not in sinks]
+
     # -- observability (reference: export_strategy_computation_graph) -----------
     def to_dot(self, include_costs: bool = False, costs=None) -> str:
         lines = ["digraph PCG {"]

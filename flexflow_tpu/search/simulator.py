@@ -196,6 +196,7 @@ class Simulator:
         execution units per chip: the compute stream (0) and the async
         collective/DMA stream (1) — collectives overlap independent compute,
         which the additive model in simulate() cannot express."""
+        from ..ffconst import size_of_datatype
         from ..native import simulate_taskgraph
 
         states = states or {}
@@ -205,6 +206,7 @@ class Simulator:
         devs: List[int] = []
         esrc: List[int] = []
         edst: List[int] = []
+        cm_cache: Dict[int, CostMetrics] = {}
 
         def add_task(cost: float, dev: int) -> int:
             costs.append(cost)
@@ -215,6 +217,7 @@ class Simulator:
             sh = assignment.get(node.guid, OpSharding())
             in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
             cm = self.op_cost(node, in_shapes, sh)
+            cm_cache[node.guid] = cm
             fwd = add_task(cm.forward_time, 0)
             idx[node.guid] = fwd
             if cm.comm_time > 0:
@@ -222,17 +225,33 @@ class Simulator:
                 esrc.append(fwd)
                 edst.append(comm)
                 idx[node.guid] = comm  # consumers wait for the collective
-            for g, _ in node.inputs:
-                if g in idx:
-                    esrc.append(idx[g])
-                    edst.append(fwd)
+            my_state = states.get(node.guid, "R")
+            for g, i in node.inputs:
+                if g not in idx:
+                    continue
+                src_task = idx[g]
+                # resharding between states rides the collective stream
+                # (reference: comm SimTasks between differently-viewed
+                # producer/consumer shards, simulator.cc:815)
+                src_state = states.get(g, "R")
+                if src_state != my_state:
+                    src_node = pcg.nodes[g]
+                    nbytes = int(np.prod(src_node.out_shapes[i])) * \
+                        size_of_datatype(src_node.op.data_type)
+                    xfer = self.resharding_cost(
+                        nbytes, src_state, my_state, sh.dp, sh.tp)
+                    if xfer > 0:
+                        r = add_task(xfer, 1)
+                        esrc.append(src_task)
+                        edst.append(r)
+                        src_task = r
+                esrc.append(src_task)
+                edst.append(fwd)
         # backward + sync: mirror the forward chain; grad allreduces go on the
         # collective stream and overlap the rest of the backward pass
         bwd_prev = None
         for node in reversed(nodes):
-            sh = assignment.get(node.guid, OpSharding())
-            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
-            cm = self.op_cost(node, in_shapes, sh)
+            cm = cm_cache[node.guid]
             bwd = add_task(cm.backward_time, 0)
             if bwd_prev is not None:
                 esrc.append(bwd_prev)

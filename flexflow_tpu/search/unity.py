@@ -109,7 +109,15 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     state; transitions pay resharding (reference:
     find_optimal_sequence_graph_time + estimate_xfer_cost). At fan-out/fan-in
     points the state is pinned to 'R' (the reference's sequence-split
-    bottlenecks are exactly such points)."""
+    bottlenecks are exactly such points).
+
+    Note on sequence splits: the reference recursively splits the graph at
+    bottleneck nodes (generic_sequence_optimize, substitution.h:276) because
+    its per-node choice space (all MachineViews) is huge. Here the DP state
+    space is two values, so the per-node table already carries every
+    bottleneck boundary condition exactly — no explicit split is needed.
+    ``PCG.bottlenecks``/``split_at_node`` expose the same machinery for
+    observability and for the substitution engine."""
     from ..ffconst import size_of_datatype
 
     nodes = pcg.compute_nodes()

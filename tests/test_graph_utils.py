@@ -1,0 +1,110 @@
+"""Graph-algorithm unit tests.
+
+Mirrors the reference's hardware-free tier (tests/unit/*.cc: dominators,
+disjoint_set, transitive reduction over BasicGraph) plus the PCG adapters.
+"""
+import pytest
+
+from flexflow_tpu.utils.graph_utils import (
+    BasicGraph, DisjointSet, dominators, find_bottlenecks, imm_dominators,
+    imm_post_dominators, pcg_basic_graph, post_dominators,
+    transitive_reduction)
+
+
+def diamond():
+    # 1 -> {2,3} -> 4 -> 5
+    return BasicGraph(edges=[(1, 2), (1, 3), (2, 4), (3, 4), (4, 5)])
+
+
+def test_dominators_diamond():
+    dom = dominators(diamond())
+    assert dom[1] == {1}
+    assert dom[2] == {1, 2}
+    assert dom[3] == {1, 3}
+    assert dom[4] == {1, 4}  # neither 2 nor 3 dominates 4
+    assert dom[5] == {1, 4, 5}
+
+
+def test_post_dominators_diamond():
+    pdom = post_dominators(diamond())
+    assert pdom[5] == {5}
+    assert pdom[1] == {1, 4, 5}
+    assert pdom[2] == {2, 4, 5}
+
+
+def test_imm_dominators():
+    idom = imm_dominators(diamond())
+    assert idom[1] == 1  # source: itself
+    assert idom[2] == 1
+    assert idom[4] == 1
+    assert idom[5] == 4
+
+
+def test_imm_post_dominators():
+    ipd = imm_post_dominators(diamond())
+    assert ipd[5] == 5
+    assert ipd[1] == 4
+    assert ipd[2] == 4
+
+
+def test_bottlenecks_diamond():
+    # every path passes through 1, 4, 5
+    assert find_bottlenecks(diamond()) == [1, 4, 5]
+
+
+def test_bottlenecks_multi_source():
+    g = BasicGraph(edges=[(1, 3), (2, 3), (3, 4)])
+    assert find_bottlenecks(g) == [3, 4]
+
+
+def test_topo_order_cycle_raises():
+    g = BasicGraph(edges=[(1, 2), (2, 1)])
+    with pytest.raises(ValueError):
+        g.topo_order()
+
+
+def test_transitive_reduction():
+    g = BasicGraph(edges=[(1, 2), (2, 3), (1, 3)])
+    r = transitive_reduction(g)
+    assert r.out_edges(1) == {2}
+    assert r.out_edges(2) == {3}
+
+
+def test_disjoint_set():
+    ds = DisjointSet()
+    ds.union(1, 2)
+    ds.union(3, 4)
+    assert ds.same(1, 2) and ds.same(3, 4)
+    assert not ds.same(1, 3)
+    ds.union(2, 3)
+    assert ds.same(1, 4)
+    assert len(ds.groups()) == 1
+
+
+def test_pcg_bottlenecks_and_split():
+    from flexflow_tpu import FFConfig, FFModel
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 8), name="x")
+    t = ff.dense(x, 16, name="d1")
+    t = ff.relu(t)
+    t = ff.dense(t, 8, name="d2")
+    t = ff.softmax(t)
+    pcg = ff.create_pcg()
+
+    bots = pcg.bottlenecks()
+    assert bots, "chain graph must have bottlenecks"
+    # split at the first bottleneck: node + ancestors go to pre
+    pre, post = pcg.split_at_node(bots[0])
+    assert len(pre) + len(post) >= len(pcg)  # post gains placeholder inputs
+    assert bots[0] in pre.nodes
+    # the split point is re-rooted as an input in post
+    from flexflow_tpu.ffconst import OperatorType
+    post_inputs = [n for n in post.topo_order()
+                   if n.op.op_type == OperatorType.OP_INPUT]
+    assert any(n.guid == bots[0] for n in post_inputs)
+    # both halves are valid topo-ordered graphs
+    assert [n.guid for n in pre.topo_order()]
+    assert [n.guid for n in post.topo_order()]
