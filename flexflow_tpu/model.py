@@ -443,6 +443,18 @@ class FFModel:
                 f.write(pcg.to_dot(
                     include_costs=self.config.include_costs_dot_graph))
 
+        # -- fusion (model.cc:2965-3040, gated by --fusion) ---------------------
+        if self.config.perform_fusion:
+            from .ops.fused import apply_fusion
+
+            pcg, n_fused = apply_fusion(pcg, self.strategy)
+            if n_fused:
+                sinks = [n for n in pcg.sinks()
+                         if n.op.op_type != OperatorType.OP_INPUT]
+                final = sinks[-1]
+                self.final_guid = final.guid
+                repl_labels = final.op.op_type == OperatorType.OP_AGG_SPEC
+
         # -- label tensor (model.cc:3090-3124) ----------------------------------
         out_shape = final.out_shapes[0]
         if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
@@ -454,6 +466,7 @@ class FFModel:
         self.label_tensor = Tensor(label_shape, label_dtype, name="label",
                                    model=self)
 
+        self.pcg = pcg
         self.executor = Executor(pcg, self.mesh, self.strategy, loss_type,
                                  self.metrics_obj, self.optimizer, self.config,
                                  self.final_guid, label_dtype, repl_labels)

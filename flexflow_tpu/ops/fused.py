@@ -1,0 +1,256 @@
+"""Operator fusion: FusedOp regions + the apply_fusion compile pass.
+
+Reference: ``FFModel::apply_fusion`` (src/runtime/model.cc:2495) merges
+consecutive ops with the same MachineView into one ``FusedOp`` leaf task
+(src/ops/fused.cc:117) whose forward is an interpreter dispatching over
+sub-op types (src/ops/fused.cu:~70-500) — the win there is cutting Legion
+per-task launch overhead.
+
+TPU-native: XLA already fuses elementwise chains into matmuls, so there is no
+launch overhead to cut. The region concept is kept because (a) it is part of
+the reference surface (``--fusion`` flag, config.h:133), and (b) the cost
+model benefits from region granularity — a fused region is costed as one
+roofline evaluation over the summed FLOPs/bytes instead of per-op memory
+round-trips, matching what XLA actually emits.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType, OperatorType
+from .base import Op, OpContext, register_op
+
+# wiring entry: ("ext", input_idx, 0) region input | ("sub", pos, out_idx)
+WireT = Tuple[str, int, int]
+
+
+@register_op(OperatorType.OP_FUSED)
+class FusedOp(Op):
+    """A region of sub-ops executed as one node.
+
+    attrs:
+      sub_ops:  List[Op] in execution order
+      wiring:   List[List[WireT]] — per sub-op, where each input comes from
+    """
+
+    def __init__(self, name: str, attrs: Dict[str, Any], dtype: DataType,
+                 num_inputs: int = 1):
+        super().__init__(name, attrs, dtype, num_inputs)
+        self.sub_ops: List[Op] = list(attrs["sub_ops"])
+        self.wiring: List[List[WireT]] = [list(w) for w in attrs["wiring"]]
+
+    # one weight namespace per sub-op position (reference: FusedOp aggregates
+    # sub-op weights into its own region list, fused.cc:117)
+    @staticmethod
+    def _prefix(i: int, sub: Op) -> str:
+        return f"sub{i}:{sub.name}:"
+
+    def params_key(self) -> Tuple:
+        return (self.op_type, self.data_type,
+                tuple(sub.params_key() for sub in self.sub_ops),
+                tuple(tuple(w) for ws in self.wiring for w in ws))
+
+    # -- shape plumbing through the region --------------------------------------
+    def _sub_in_shapes(self, input_shapes, sub_out_shapes, i):
+        out = []
+        for kind, j, k in self.wiring[i]:
+            out.append(input_shapes[j] if kind == "ext"
+                       else sub_out_shapes[j][k])
+        return out
+
+    def _trace_shapes(self, input_shapes):
+        sub_out_shapes: List[List[Tuple[int, ...]]] = []
+        for i, sub in enumerate(self.sub_ops):
+            ins = self._sub_in_shapes(input_shapes, sub_out_shapes, i)
+            sub_out_shapes.append(
+                [tuple(s) for s in sub.infer_output_shapes(ins)])
+        return sub_out_shapes
+
+    def infer_output_shapes(self, input_shapes):
+        return self._trace_shapes(input_shapes)[-1]
+
+    def output_dtype(self, input_dtypes):
+        return self.sub_ops[-1].data_type
+
+    def weight_specs(self, input_shapes):
+        sub_out_shapes = self._trace_shapes(input_shapes)
+        specs = {}
+        for i, sub in enumerate(self.sub_ops):
+            ins = self._sub_in_shapes(input_shapes, sub_out_shapes, i)
+            for wname, spec in sub.weight_specs(ins).items():
+                specs[self._prefix(i, sub) + wname] = spec
+        return specs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax
+
+        sub_outs: List[List[Any]] = []
+        for i, sub in enumerate(self.sub_ops):
+            ins = [inputs[j] if kind == "ext" else sub_outs[j][k]
+                   for kind, j, k in self.wiring[i]]
+            pfx = self._prefix(i, sub)
+            sub_params = {k[len(pfx):]: v for k, v in params.items()
+                          if k.startswith(pfx)}
+            sub_ctx = OpContext(
+                training=ctx.training,
+                rng=(jax.random.fold_in(ctx.rng, i)
+                     if ctx.rng is not None else None),
+                seq_length=ctx.seq_length, mesh=ctx.mesh,
+                profiling=ctx.profiling, aux_losses=ctx.aux_losses)
+            sub_outs.append(sub.forward(sub_params, ins, sub_ctx))
+        return sub_outs[-1]
+
+    # -- cost model: one roofline over the region --------------------------------
+    def flops(self, input_shapes, output_shapes):
+        sub_out_shapes = self._trace_shapes(input_shapes)
+        total = 0
+        for i, sub in enumerate(self.sub_ops):
+            ins = self._sub_in_shapes(input_shapes, sub_out_shapes, i)
+            total += sub.flops(ins, sub_out_shapes[i])
+        return total
+
+    def memory_bytes(self, input_shapes, output_shapes):
+        # region boundary traffic only — intermediates stay in registers/VMEM
+        # (this is exactly the fusion win the cost model should see)
+        from ..ffconst import size_of_datatype
+
+        el = size_of_datatype(self.data_type)
+        return el * (sum(int(np.prod(s)) for s in input_shapes)
+                     + sum(int(np.prod(s)) for s in output_shapes))
+
+
+# ------------------------------------------------------------------ the pass
+_FUSE_EXCLUDED = {
+    OperatorType.OP_INPUT, OperatorType.OP_WEIGHT, OperatorType.OP_FUSED,
+    OperatorType.OP_CACHE,  # stateful across iterations
+    OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE,
+    OperatorType.OP_REPLICATE, OperatorType.OP_REDUCTION,
+    OperatorType.OP_FUSED_PARALLEL, OperatorType.OP_PIPELINE,
+    OperatorType.OP_ALLTOALL,
+}
+
+
+def _eligible(node, strategy) -> bool:
+    if node.op.op_type in _FUSE_EXCLUDED:
+        return False
+    if len(node.out_shapes) != 1:
+        return False
+    ns = strategy.node_strategies.get(node.guid) if strategy else None
+    # only fuse nodes the strategy doesn't pin (no sharded weights, no output
+    # constraint) — the reference requires identical MachineViews
+    # (model.cc:2970); unpinned nodes all share the default view
+    if ns is not None and (ns.weight_specs or ns.output_spec is not None
+                           or ns.extra):
+        return False
+    return True
+
+
+def apply_fusion(pcg, strategy=None, max_region: int = 16):
+    """Merge single-consumer chains of same-view ops into FusedOp nodes.
+
+    Returns (new_pcg, n_fused_regions). ``strategy`` (if given) is updated
+    in place: chain members' entries are dropped (they had none of interest
+    — _eligible guarantees it).
+
+    Reference: FFModel::apply_fusion loop (model.cc:2965-3040).
+    """
+    from ..parallel.pcg import PCG, PCGNode, _node_guid
+
+    consumers: Dict[int, List[int]] = {}
+    for n in pcg.topo_order():
+        for g, _ in n.inputs:
+            consumers.setdefault(g, []).append(n.guid)
+
+    # build chains greedily along sole-consumer edges
+    in_chain: Dict[int, int] = {}  # guid -> chain id
+    chains: List[List[int]] = []
+    for node in pcg.topo_order():
+        if node.guid in in_chain or not _eligible(node, strategy):
+            continue
+        chain = [node.guid]
+        cur = node
+        while len(chain) < max_region:
+            cons = consumers.get(cur.guid, [])
+            if len(cons) != 1:
+                break
+            nxt = pcg.nodes[cons[0]]
+            # `nxt` must consume cur exactly once and be eligible
+            if not _eligible(nxt, strategy) or nxt.guid in in_chain:
+                break
+            if sum(1 for g, _ in nxt.inputs if g == cur.guid) != 1:
+                break
+            chain.append(nxt.guid)
+            cur = nxt
+        if len(chain) >= 2:
+            cid = len(chains)
+            chains.append(chain)
+            for g in chain:
+                in_chain[g] = cid
+
+    if not chains:
+        return pcg, 0
+
+    # rebuild the graph, replacing each chain with one FusedOp node
+    new = PCG()
+    remap: Dict[int, Tuple[int, int]] = {}  # old guid -> (new guid, out idx)
+    for node in pcg.topo_order():
+        cid = in_chain.get(node.guid)
+        if cid is None:
+            # non-fused producers keep their output indices (-1 marker);
+            # fused producers collapse to output 0
+            nn = PCGNode(guid=node.guid, op=node.op,
+                         inputs=[(remap[g][0],
+                                  i if remap[g][1] < 0 else remap[g][1])
+                                 for g, i in node.inputs],
+                         out_shapes=list(node.out_shapes),
+                         out_dtypes=list(node.out_dtypes),
+                         machine_view=node.machine_view)
+            new.nodes[nn.guid] = nn
+            new._order.append(nn.guid)
+            remap[node.guid] = (node.guid, -1)  # -1: keep original out idx
+            continue
+        chain = chains[cid]
+        if node.guid != chain[-1]:
+            # emit the region at its LAST member: every external producer of
+            # every member is topologically earlier, so remap is complete
+            continue
+        members = [pcg.nodes[g] for g in chain]
+        member_pos = {g: i for i, g in enumerate(chain)}
+        ext_inputs: List[Tuple[int, int]] = []  # (old guid, out idx)
+        ext_index: Dict[Tuple[int, int], int] = {}
+        wiring: List[List[WireT]] = []
+        for m in members:
+            ws: List[WireT] = []
+            for g, i in m.inputs:
+                if g in member_pos:
+                    ws.append(("sub", member_pos[g], i))
+                else:
+                    key = (g, i)
+                    if key not in ext_index:
+                        ext_index[key] = len(ext_inputs)
+                        ext_inputs.append(key)
+                    ws.append(("ext", ext_index[key], 0))
+            wiring.append(ws)
+        tail = members[-1]
+        fused = FusedOp(
+            name="fused_" + "+".join(m.name for m in members),
+            attrs={"sub_ops": [m.op for m in members], "wiring": wiring},
+            dtype=tail.op.data_type, num_inputs=len(ext_inputs))
+        guid = next(_node_guid)
+        nn = PCGNode(
+            guid=guid, op=fused,
+            inputs=[(remap[g][0], i if remap[g][1] < 0 else remap[g][1])
+                    for g, i in ext_inputs],
+            out_shapes=list(tail.out_shapes),
+            out_dtypes=list(tail.out_dtypes),
+            machine_view=tail.machine_view)
+        new.nodes[guid] = nn
+        new._order.append(guid)
+        for g in chain:
+            remap[g] = (guid, 0)
+        if strategy is not None:
+            for g in chain:
+                strategy.node_strategies.pop(g, None)
+    return new, len(chains)

@@ -1,0 +1,104 @@
+"""Fusion pass tests (reference: FFModel::apply_fusion, model.cc:2495;
+FusedOp interpreter, src/ops/fused.cu)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.ffconst import OperatorType
+
+
+def build(config):
+    ff = FFModel(config)
+    x = ff.create_tensor((config.batch_size, 32), name="x")
+    t = ff.dense(x, 64, name="d1")
+    t = ff.relu(t)
+    t = ff.dense(t, 10, name="d2")
+    t = ff.softmax(t)
+    return ff
+
+
+def _data(config):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 32)).astype(np.float32)
+    w = rng.normal(size=(32, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def test_apply_fusion_merges_chain():
+    config = FFConfig()
+    config.batch_size = 32
+    config.perform_fusion = True
+    config.only_data_parallel = True
+    ff = build(config)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    fused = [n for n in ff.pcg.compute_nodes()
+             if n.op.op_type == OperatorType.OP_FUSED]
+    assert len(fused) == 1, [n.name for n in ff.pcg.compute_nodes()]
+    # the whole dense-relu-dense-softmax chain collapsed into one region
+    assert len(fused[0].op.sub_ops) == 4
+    assert len(ff.pcg.compute_nodes()) == 1
+
+
+def test_fused_training_matches_unfused():
+    losses = {}
+    for fuse in (False, True):
+        config = FFConfig()
+        config.batch_size = 32
+        config.perform_fusion = fuse
+        config.only_data_parallel = True
+        ff = build(config)
+        ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        x, y = _data(config)
+        ff.fit(x, y, epochs=2)
+        m = ff.get_perf_metrics()
+        losses[fuse] = m.train_correct / max(m.train_all, 1)
+    # identical init (weight entries enumerate in the same order) ->
+    # identical training trajectory
+    assert losses[True] == pytest.approx(losses[False], abs=1e-6)
+
+
+def test_fusion_cost_model_sees_region():
+    """A fused region must cost less memory traffic than the op-by-op sum."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+
+    config = FFConfig()
+    config.batch_size = 32
+    config.perform_fusion = True
+    config.only_data_parallel = True
+    ff = build(config)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    node = ff.pcg.compute_nodes()[0]
+    assert node.op.op_type == OperatorType.OP_FUSED
+    in_shapes = [ff.pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+    # flops equals the sum over sub-ops; memory_bytes only boundary traffic
+    flops = node.op.flops(in_shapes, node.out_shapes)
+    assert flops > 2 * 32 * 32 * 64  # at least the two matmuls
+    mb = node.op.memory_bytes(in_shapes, node.out_shapes)
+    el_in = int(np.prod(in_shapes[0])) * 4
+    el_out = int(np.prod(node.out_shapes[0])) * 4
+    assert mb == el_in + el_out
+
+
+def test_fusion_stops_at_multi_consumer():
+    config = FFConfig()
+    config.batch_size = 16
+    config.perform_fusion = True
+    config.only_data_parallel = True
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 8), name="x")
+    a = ff.dense(x, 8, name="a")
+    b = ff.relu(a)
+    c = ff.tanh(a)  # `a` has two consumers -> cannot fuse past it
+    d = ff.add(b, c)
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    fused = [n for n in ff.pcg.compute_nodes()
+             if n.op.op_type == OperatorType.OP_FUSED]
+    names = {n.name for n in ff.pcg.compute_nodes()}
+    # `a` must remain standalone (auto-named a_0)
+    assert any(n.name.startswith("a") and
+               n.op.op_type == OperatorType.OP_LINEAR
+               for n in ff.pcg.compute_nodes()), names
