@@ -20,4 +20,6 @@ from .execution.initializers import (GlorotUniformInitializer,  # noqa: F401
                                      ZeroInitializer, ConstantInitializer,
                                      UniformInitializer, NormInitializer)
 
+from .parallel.pipeline import PipelineTrainer  # noqa: F401,E402
+
 __version__ = "0.1.0"
