@@ -183,6 +183,9 @@ class OperatorType(enum.IntEnum):
     OP_RMSNORM = 110
     OP_RING_ATTENTION = 111
     OP_ALLTOALL = 112
+    # recurrent family (reference: nmt/ hand-written lstm.cu predating the
+    # FFModel op set; we promote it to a first-class op)
+    OP_LSTM = 113
 
 
 # --- dtype helpers -------------------------------------------------------------

@@ -279,6 +279,19 @@ class FFModel:
     def reverse(self, x, axis: int, name=None):
         return self._unary(OperatorType.OP_REVERSE, x, {"axis": axis}, name)
 
+    def lstm(self, input: Tensor, hidden_size: int,
+             initial_state: Optional[Tensor] = None,
+             name: Optional[str] = None) -> List[Tensor]:
+        """LSTM over (batch, seq, dim) -> [(batch, seq, hidden),
+        final_state (batch, 2*hidden)]. Reference: nmt/lstm.cu (cuDNN RNN);
+        here a first-class op (ops/recurrent.py)."""
+        inputs = [input] + ([initial_state] if initial_state is not None
+                            else [])
+        outs = self._add_layer(OperatorType.OP_LSTM, inputs,
+                               {"hidden_size": hidden_size},
+                               input.dtype, name, num_outputs=2)
+        return outs if isinstance(outs, list) else [outs]
+
     def concat(self, tensors: List[Tensor], axis: int, name=None):
         return self._add_layer(OperatorType.OP_CONCAT, list(tensors),
                                {"axis": axis}, tensors[0].dtype, name)

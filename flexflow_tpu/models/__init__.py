@@ -1,8 +1,12 @@
-"""Model zoo matching the reference's benchmark configs (BASELINE.md):
-AlexNet/CIFAR-10, ResNet-50, Transformer NMT, BERT-Large, DLRM, MoE."""
+"""Model zoo matching the reference's example apps (SURVEY §2.5):
+AlexNet/CIFAR-10, ResNet-50, ResNeXt-50, InceptionV3, Transformer, BERT-Large,
+DLRM, XDL, MLP_Unify, CANDLE-Uno, MoE, NMT (LSTM seq2seq)."""
 from .bert import BertConfig, build_bert, bert_param_count  # noqa: F401
 from .vision import (build_alexnet, build_alexnet_cifar10,  # noqa: F401
-                     build_resnet50)
+                     build_resnet50, build_resnext50, build_inception_v3)
 from .dlrm import build_dlrm  # noqa: F401
 from .transformer import (TransformerConfig, build_transformer,  # noqa: F401
                           build_moe_mlp)
+from .misc import (build_mlp_unify, build_xdl,  # noqa: F401
+                   build_candle_uno)
+from .nmt import NMTConfig, build_nmt  # noqa: F401

@@ -1,0 +1,65 @@
+"""NMT: LSTM encoder-decoder sequence-to-sequence model.
+
+Reference: nmt/ (rnn.h:31-32 — batchSize/hiddenSize/embedSize/vocabSize/
+numLayers/seqLength; embed.cu, lstm.cu, linear.cu) — the legacy pre-FFModel
+LSTM NMT app. Rebuilt on the modern builder API: encoder embed + stacked
+LSTM; decoder embed + stacked LSTM initialized from the encoder's final
+state (the hand-off nmt.cc wires manually between per-node LSTM chunks);
+projection to target vocab + softmax, trained with teacher forcing.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..ffconst import AggrMode, DataType
+from ..model import FFModel
+
+
+@dataclasses.dataclass
+class NMTConfig:
+    batch_size: int = 64
+    src_vocab: int = 32000
+    tgt_vocab: int = 32000
+    embed_size: int = 1024   # rnn.h embedSize
+    hidden_size: int = 1024  # rnn.h hiddenSize
+    num_layers: int = 2      # rnn.h numLayers
+    src_len: int = 40        # rnn.h seqLength
+    tgt_len: int = 40
+
+    @staticmethod
+    def tiny(batch_size: int = 8) -> "NMTConfig":
+        return NMTConfig(batch_size=batch_size, src_vocab=100, tgt_vocab=100,
+                         embed_size=16, hidden_size=16, num_layers=2,
+                         src_len=6, tgt_len=5)
+
+
+def build_nmt(ff: FFModel, cfg: NMTConfig):
+    """Returns ([src_tokens, tgt_tokens], logits(batch, tgt_len, tgt_vocab)
+    softmaxed). Loss: sparse CCE over flattened (batch*tgt_len,) labels —
+    callers reshape as in examples/nmt.py."""
+    src = ff.create_tensor((cfg.batch_size, cfg.src_len),
+                           dtype=DataType.DT_INT32, name="nmt_src")
+    tgt = ff.create_tensor((cfg.batch_size, cfg.tgt_len),
+                           dtype=DataType.DT_INT32, name="nmt_tgt")
+
+    # encoder
+    t = ff.embedding(src, cfg.src_vocab, cfg.embed_size,
+                     AggrMode.AGGR_MODE_NONE, name="enc_embed")
+    states = []
+    for i in range(cfg.num_layers):
+        t, state = ff.lstm(t, cfg.hidden_size, name=f"enc_lstm{i}")
+        states.append(state)
+
+    # decoder: each layer starts from the matching encoder layer's final
+    # state (nmt.cc's chunk-to-chunk hidden hand-off)
+    d = ff.embedding(tgt, cfg.tgt_vocab, cfg.embed_size,
+                     AggrMode.AGGR_MODE_NONE, name="dec_embed")
+    for i in range(cfg.num_layers):
+        d, _ = ff.lstm(d, cfg.hidden_size, initial_state=states[i],
+                       name=f"dec_lstm{i}")
+
+    logits = ff.dense(d, cfg.tgt_vocab, name="nmt_proj")
+    # flatten (batch, tgt_len) so sparse-CCE sees per-token rows
+    logits = ff.reshape(logits, (cfg.batch_size * cfg.tgt_len, cfg.tgt_vocab))
+    probs = ff.softmax(logits)
+    return [src, tgt], probs

@@ -10,3 +10,5 @@ from . import attention  # noqa: F401
 from . import embedding  # noqa: F401
 from . import moe_ops  # noqa: F401
 from . import noop  # noqa: F401
+from . import recurrent  # noqa: F401
+from . import fused  # noqa: F401

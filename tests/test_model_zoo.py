@@ -1,0 +1,153 @@
+"""New model-family coverage: InceptionV3, ResNeXt-50, MLP_Unify, XDL,
+CANDLE-Uno, NMT LSTM (reference apps: examples/cpp/* + nmt/)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType, SGDOptimizer)
+from flexflow_tpu.models import (NMTConfig, build_candle_uno,
+                                 build_inception_v3, build_mlp_unify,
+                                 build_nmt, build_resnext50, build_xdl)
+
+
+def _config(bs):
+    c = FFConfig()
+    c.batch_size = bs
+    c.only_data_parallel = True
+    return c
+
+
+def test_lstm_op_numerics():
+    """LSTM forward against a straightforward numpy recurrence."""
+    import jax
+
+    from flexflow_tpu.ops.recurrent import LSTMOp
+    from flexflow_tpu.ops.base import OpContext
+    from flexflow_tpu.ffconst import DataType
+
+    rng = np.random.default_rng(0)
+    b, s, d, h = 2, 5, 3, 4
+    x = rng.normal(size=(b, s, d)).astype(np.float32)
+    op = LSTMOp("lstm", {"hidden_size": h}, DataType.DT_FLOAT)
+    wspecs = op.weight_specs([(b, s, d)])
+    key = jax.random.PRNGKey(0)
+    params = {n: init(jax.random.fold_in(key, i), shape, np.float32)
+              for i, (n, (shape, dt, init)) in enumerate(wspecs.items())}
+    outs = op.forward(params, [x], OpContext(training=False))
+    y, final = np.asarray(outs[0]), np.asarray(outs[1])
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    wx, wh, bias = (np.asarray(params[k]) for k in ("wx", "wh", "bias"))
+    ht = np.zeros((b, h), np.float32)
+    ct = np.zeros((b, h), np.float32)
+    for t in range(s):
+        gates = x[:, t] @ wx + ht @ wh + bias
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        ct = sigmoid(f) * ct + sigmoid(i) * np.tanh(g)
+        ht = sigmoid(o) * np.tanh(ct)
+        np.testing.assert_allclose(y[:, t], ht, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(final, np.concatenate([ht, ct], -1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_inception_v3_shapes():
+    ff = FFModel(_config(2))
+    x, out = build_inception_v3(ff, batch_size=2, image_size=299,
+                                num_classes=10)
+    assert out.dims == (2, 10)
+    # 2048 channels before the head (standard InceptionV3)
+    pcg = ff.create_pcg()
+    concat_channels = [n.out_shapes[0][1] for n in pcg.compute_nodes()
+                       if n.op.op_type.name == "OP_CONCAT"]
+    assert concat_channels[-1] == 2048, concat_channels
+
+
+def test_resnext50_trains_step():
+    config = _config(8)
+    ff = FFModel(config)
+    x_t, out = build_resnext50(ff, batch_size=8, image_size=64,
+                               num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 3, 64, 64)).astype(np.float32)
+    y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+    ff.fit(x, y, epochs=1)
+
+
+def test_mlp_unify_trains():
+    config = _config(8)
+    ff = FFModel(config)
+    inputs, out = build_mlp_unify(ff, batch_size=8, input_dim=32,
+                                  hidden_dims=(64, 64, 10))
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    rng = np.random.default_rng(0)
+    x1 = rng.normal(size=(32, 32)).astype(np.float32)
+    x2 = rng.normal(size=(32, 32)).astype(np.float32)
+    y = rng.integers(0, 10, size=(32,)).astype(np.int32)
+    ff.fit([x1, x2], y, epochs=2)
+
+
+def test_xdl_trains():
+    config = _config(8)
+    ff = FFModel(config)
+    sparse, out = build_xdl(ff, batch_size=8, num_embeddings=3,
+                            vocab_size=50, sparse_feature_size=8,
+                            dense_dims=(16, 1))
+    assert out.dims == (8, 1)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, 50, size=(32, 1)).astype(np.int32)
+          for _ in range(3)]
+    y = rng.random(size=(32, 1)).astype(np.float32)
+    ff.fit(xs, y, epochs=1)
+
+
+def test_candle_uno_builds():
+    ff = FFModel(_config(8))
+    inputs, out = build_candle_uno(
+        ff, batch_size=8, dense_layers=(32,) * 2,
+        dense_feature_layers=(32,) * 2,
+        feature_shapes={"dose": 1, "cell.rnaseq": 16,
+                        "drug.descriptors": 24, "drug.fingerprints": 20})
+    assert len(inputs) == 7  # dose1, dose2, rnaseq, 2x descriptors, 2x fp
+    assert out.dims == (8, 1)
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=t.dims).astype(np.float32) for t in inputs]
+    y = rng.normal(size=(8, 1)).astype(np.float32)
+    res = ff.eval(xs, y)
+    assert res.train_all == 8
+
+
+def test_nmt_trains_and_learns():
+    """Tiny copy task: target = source tokens; loss must drop."""
+    cfg = NMTConfig.tiny(batch_size=8)
+    config = _config(cfg.batch_size)
+    ff = FFModel(config)
+    inputs, out = build_nmt(ff, cfg)
+    assert out.dims == (cfg.batch_size * cfg.tgt_len, cfg.tgt_vocab)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=5e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    n = cfg.batch_size  # the reshape op pins batch*tgt_len rows
+    src = rng.integers(1, cfg.src_vocab, size=(n, cfg.src_len)
+                       ).astype(np.int32)
+    tgt_in = src[:, :cfg.tgt_len]
+    labels = src[:, :cfg.tgt_len].reshape(-1).astype(np.int32)
+
+    import jax
+    step = ff.executor.make_train_step()
+    params, opt_state = ff.params, ff.opt_state
+    losses = []
+    key = jax.random.PRNGKey(0)
+    for i in range(60):
+        params, opt_state, loss, _ = step(
+            params, opt_state, [src, tgt_in], labels, key)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
