@@ -66,7 +66,7 @@ class FFModel:
     # ================================================================ builders ==
     def _add_layer(self, op_type: OperatorType, inputs: List[Tensor],
                    attrs: Dict[str, Any], dtype: Optional[DataType] = None,
-                   name: Optional[str] = None, num_outputs: int = 1
+                   name: Optional[str] = None
                    ) -> Union[Tensor, List[Tensor]]:
         from .ops.base import op_class_for
 
@@ -287,10 +287,9 @@ class FFModel:
         here a first-class op (ops/recurrent.py)."""
         inputs = [input] + ([initial_state] if initial_state is not None
                             else [])
-        outs = self._add_layer(OperatorType.OP_LSTM, inputs,
+        return self._add_layer(OperatorType.OP_LSTM, inputs,
                                {"hidden_size": hidden_size},
-                               input.dtype, name, num_outputs=2)
-        return outs if isinstance(outs, list) else [outs]
+                               input.dtype, name)
 
     def concat(self, tensors: List[Tensor], axis: int, name=None):
         return self._add_layer(OperatorType.OP_CONCAT, list(tensors),

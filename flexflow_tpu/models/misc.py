@@ -8,7 +8,7 @@ stack — an ads-CTR model like DLRM), examples/cpp/candle_uno/candle_uno.cc
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from ..ffconst import ActiMode, AggrMode, DataType
 from ..model import FFModel
@@ -85,18 +85,38 @@ def build_candle_uno(ff: FFModel, batch_size: int = 64,
     input_features = input_features or dict(_UNO_INPUT_FEATURES)
 
     inputs = []
-    encoded = []
+    # towers are shared per feature TYPE (candle_uno.cc:104-131 builds one
+    # feature_model per type and reuses it for drug1/drug2): stack all inputs
+    # of a type along batch, run the tower once, split back per key
+    by_type: Dict[str, list] = {}
+    order = []
     for key, ftype in input_features.items():
         dim = feature_shapes[ftype]
         x = ff.create_tensor((batch_size, dim),
                              name=f"uno_{key.replace('.', '_')}")
         inputs.append(x)
-        t = x
-        if ftype != "dose":  # dose passes through raw (candle_uno.cc:115-121)
-            for i, h in enumerate(dense_feature_layers):
-                t = ff.dense(t, h, relu, use_bias=False,
-                             name=f"enc_{key.replace('.', '_')}_d{i}")
-        encoded.append(t)
+        by_type.setdefault(ftype, []).append(x)
+        order.append((key, ftype))
+
+    encoded_by_type: Dict[str, list] = {}
+    for ftype, xs in by_type.items():
+        safe = ftype.replace('.', '_')
+        if ftype == "dose":  # dose passes through raw (candle_uno.cc:115-121)
+            encoded_by_type[ftype] = list(xs)
+            continue
+        t = xs[0] if len(xs) == 1 else ff.concat(xs, axis=0)
+        for i, h in enumerate(dense_feature_layers):
+            t = ff.dense(t, h, relu, use_bias=False, name=f"enc_{safe}_d{i}")
+        if len(xs) == 1:
+            encoded_by_type[ftype] = [t]
+        else:
+            encoded_by_type[ftype] = ff.split(t, [batch_size] * len(xs),
+                                              axis=0)
+    counters = {ftype: 0 for ftype in by_type}
+    encoded = []
+    for key, ftype in order:
+        encoded.append(encoded_by_type[ftype][counters[ftype]])
+        counters[ftype] += 1
     t = ff.concat(encoded, axis=-1)
     for i, h in enumerate(dense_layers):
         t = ff.dense(t, h, relu, use_bias=False, name=f"head_d{i}")

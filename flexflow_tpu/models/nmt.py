@@ -34,9 +34,14 @@ class NMTConfig:
 
 
 def build_nmt(ff: FFModel, cfg: NMTConfig):
-    """Returns ([src_tokens, tgt_tokens], logits(batch, tgt_len, tgt_vocab)
-    softmaxed). Loss: sparse CCE over flattened (batch*tgt_len,) labels —
-    callers reshape as in examples/nmt.py."""
+    """Returns ([src_tokens, tgt_tokens], per-token probs of shape
+    (batch*tgt_len, tgt_vocab)). Loss: sparse CCE over flattened
+    (batch*tgt_len,) labels — drive with executor.make_train_step and
+    labels.reshape(-1) (see tests/test_model_zoo.py), reassigning the
+    returned params/opt_state back to ff.params/ff.opt_state each step
+    (the step donates its input buffers, so the old arrays are deleted on
+    TPU); FFModel.fit slices labels by batch rows, so flattened token
+    labels don't fit it."""
     src = ff.create_tensor((cfg.batch_size, cfg.src_len),
                            dtype=DataType.DT_INT32, name="nmt_src")
     tgt = ff.create_tensor((cfg.batch_size, cfg.tgt_len),

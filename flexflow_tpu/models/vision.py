@@ -108,51 +108,55 @@ def _inception_a(ff, t, pool_features, name):
 
 
 def _inception_b(ff, t, name):
-    t1 = ff.conv2d(t, 384, 3, 3, 2, 2, 0, 0, name=f"{name}_b1")
-    t2 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, name=f"{name}_b2a")
-    t2 = ff.conv2d(t2, 96, 3, 3, 1, 1, 1, 1, name=f"{name}_b2b")
-    t2 = ff.conv2d(t2, 96, 3, 3, 2, 2, 0, 0, name=f"{name}_b2c")
+    relu = ActiMode.AC_MODE_RELU
+    t1 = ff.conv2d(t, 384, 3, 3, 2, 2, 0, 0, relu, name=f"{name}_b1")
+    t2 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b2a")
+    t2 = ff.conv2d(t2, 96, 3, 3, 1, 1, 1, 1, relu, name=f"{name}_b2b")
+    t2 = ff.conv2d(t2, 96, 3, 3, 2, 2, 0, 0, relu, name=f"{name}_b2c")
     t3 = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
     return ff.concat([t1, t2, t3], 1)
 
 
 def _inception_c(ff, t, channels, name):
-    t1 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, name=f"{name}_b1")
-    t2 = ff.conv2d(t, channels, 1, 1, 1, 1, 0, 0, name=f"{name}_b2a")
-    t2 = ff.conv2d(t2, channels, 1, 7, 1, 1, 0, 3, name=f"{name}_b2b")
-    t2 = ff.conv2d(t2, 192, 7, 1, 1, 1, 3, 0, name=f"{name}_b2c")
-    t3 = ff.conv2d(t, channels, 1, 1, 1, 1, 0, 0, name=f"{name}_b3a")
-    t3 = ff.conv2d(t3, channels, 7, 1, 1, 1, 3, 0, name=f"{name}_b3b")
-    t3 = ff.conv2d(t3, channels, 1, 7, 1, 1, 0, 3, name=f"{name}_b3c")
-    t3 = ff.conv2d(t3, channels, 7, 1, 1, 1, 3, 0, name=f"{name}_b3d")
-    t3 = ff.conv2d(t3, 192, 1, 7, 1, 1, 0, 3, name=f"{name}_b3e")
+    relu = ActiMode.AC_MODE_RELU
+    t1 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b1")
+    t2 = ff.conv2d(t, channels, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b2a")
+    t2 = ff.conv2d(t2, channels, 1, 7, 1, 1, 0, 3, relu, name=f"{name}_b2b")
+    t2 = ff.conv2d(t2, 192, 7, 1, 1, 1, 3, 0, relu, name=f"{name}_b2c")
+    t3 = ff.conv2d(t, channels, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b3a")
+    t3 = ff.conv2d(t3, channels, 7, 1, 1, 1, 3, 0, relu, name=f"{name}_b3b")
+    t3 = ff.conv2d(t3, channels, 1, 7, 1, 1, 0, 3, relu, name=f"{name}_b3c")
+    t3 = ff.conv2d(t3, channels, 7, 1, 1, 1, 3, 0, relu, name=f"{name}_b3d")
+    t3 = ff.conv2d(t3, 192, 1, 7, 1, 1, 0, 3, relu, name=f"{name}_b3e")
     t4 = ff.pool2d(t, 3, 3, 1, 1, 1, 1, PoolType.POOL_AVG)
-    t4 = ff.conv2d(t4, 192, 1, 1, 1, 1, 0, 0, name=f"{name}_b4")
+    t4 = ff.conv2d(t4, 192, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b4")
     return ff.concat([t1, t2, t3, t4], 1)
 
 
 def _inception_d(ff, t, name):
-    t1 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, name=f"{name}_b1a")
-    t1 = ff.conv2d(t1, 320, 3, 3, 2, 2, 0, 0, name=f"{name}_b1b")
-    t2 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, name=f"{name}_b2a")
-    t2 = ff.conv2d(t2, 192, 1, 7, 1, 1, 0, 3, name=f"{name}_b2b")
-    t2 = ff.conv2d(t2, 192, 7, 1, 1, 1, 3, 0, name=f"{name}_b2c")
-    t2 = ff.conv2d(t2, 192, 3, 3, 2, 2, 0, 0, name=f"{name}_b2d")
+    relu = ActiMode.AC_MODE_RELU
+    t1 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b1a")
+    t1 = ff.conv2d(t1, 320, 3, 3, 2, 2, 0, 0, relu, name=f"{name}_b1b")
+    t2 = ff.conv2d(t, 192, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b2a")
+    t2 = ff.conv2d(t2, 192, 1, 7, 1, 1, 0, 3, relu, name=f"{name}_b2b")
+    t2 = ff.conv2d(t2, 192, 7, 1, 1, 1, 3, 0, relu, name=f"{name}_b2c")
+    t2 = ff.conv2d(t2, 192, 3, 3, 2, 2, 0, 0, relu, name=f"{name}_b2d")
     t3 = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
     return ff.concat([t1, t2, t3], 1)
 
 
 def _inception_e(ff, t, name):
-    t1 = ff.conv2d(t, 320, 1, 1, 1, 1, 0, 0, name=f"{name}_b1")
-    t2i = ff.conv2d(t, 384, 1, 1, 1, 1, 0, 0, name=f"{name}_b2a")
-    t2a = ff.conv2d(t2i, 384, 1, 3, 1, 1, 0, 1, name=f"{name}_b2b")
-    t2b = ff.conv2d(t2i, 384, 3, 1, 1, 1, 1, 0, name=f"{name}_b2c")
-    t3i = ff.conv2d(t, 448, 1, 1, 1, 1, 0, 0, name=f"{name}_b3a")
-    t3i = ff.conv2d(t3i, 384, 3, 3, 1, 1, 1, 1, name=f"{name}_b3b")
-    t3a = ff.conv2d(t3i, 384, 1, 3, 1, 1, 0, 1, name=f"{name}_b3c")
-    t3b = ff.conv2d(t3i, 384, 3, 1, 1, 1, 1, 0, name=f"{name}_b3d")
+    relu = ActiMode.AC_MODE_RELU
+    t1 = ff.conv2d(t, 320, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b1")
+    t2i = ff.conv2d(t, 384, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b2a")
+    t2a = ff.conv2d(t2i, 384, 1, 3, 1, 1, 0, 1, relu, name=f"{name}_b2b")
+    t2b = ff.conv2d(t2i, 384, 3, 1, 1, 1, 1, 0, relu, name=f"{name}_b2c")
+    t3i = ff.conv2d(t, 448, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b3a")
+    t3i = ff.conv2d(t3i, 384, 3, 3, 1, 1, 1, 1, relu, name=f"{name}_b3b")
+    t3a = ff.conv2d(t3i, 384, 1, 3, 1, 1, 0, 1, relu, name=f"{name}_b3c")
+    t3b = ff.conv2d(t3i, 384, 3, 1, 1, 1, 1, 0, relu, name=f"{name}_b3d")
     t4 = ff.pool2d(t, 3, 3, 1, 1, 1, 1, PoolType.POOL_AVG)
-    t4 = ff.conv2d(t4, 192, 1, 1, 1, 1, 0, 0, name=f"{name}_b4")
+    t4 = ff.conv2d(t4, 192, 1, 1, 1, 1, 0, 0, relu, name=f"{name}_b4")
     return ff.concat([t1, t2a, t2b, t3a, t3b, t4], 1)
 
 

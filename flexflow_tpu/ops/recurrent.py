@@ -15,11 +15,7 @@ Outputs: [sequence_outputs, final_state(batch, 2*hidden)].
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
-
-import numpy as np
-
-from ..ffconst import DataType, OperatorType
+from ..ffconst import OperatorType
 from .base import Op, OpContext, register_op
 
 

@@ -1,0 +1,450 @@
+"""FF-vs-PyTorch alignment tests — the TPU analog of the reference's
+tests/align tier (align_test.py, SURVEY §4), its strongest correctness
+signal: per-operator FORWARD and GRADIENT equality against real PyTorch.
+
+Where the reference dumps tensors from a GPU run and diffs them against a
+torch run in a second conda env (tests/align/README.md:1-8), we run both
+stacks in-process: the op's jax forward (+ jax.grad through a random-cotangent
+scalar loss) vs the identical torch computation (+ autograd), same weights.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from flexflow_tpu.ffconst import (ActiMode, AggrMode, DataType, LossType,
+                                  OperatorType)
+from flexflow_tpu.ops.base import OpContext, op_class_for
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _run_with_grads(op_type, attrs, inputs, params, cots, n_inputs=None):
+    """Forward + grads of sum(out_i * cot_i) wrt (params, float inputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    op = op_class_for(op_type)("t", attrs, DataType.DT_FLOAT,
+                               num_inputs=n_inputs or len(inputs))
+    ctx = OpContext(training=False, rng=jax.random.PRNGKey(0))
+
+    diff_idx = [i for i, a in enumerate(inputs)
+                if np.issubdtype(np.asarray(a).dtype, np.floating)]
+
+    def scalar(p, diff_inputs):
+        full = list(inputs)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_inputs[j]
+        outs = op.forward(p, full, ctx)
+        return sum(jnp.sum(o * c) for o, c in zip(outs, cots)), outs
+
+    diff_in = [jnp.asarray(inputs[i]) for i in diff_idx]
+    (_, outs), (gp, gi) = jax.value_and_grad(
+        scalar, argnums=(0, 1), has_aux=True)(params, diff_in)
+    grads_in = [None] * len(inputs)
+    for j, i in enumerate(diff_idx):
+        grads_in[i] = np.asarray(gi[j])
+    return ([np.asarray(o) for o in outs], {k: np.asarray(v)
+            for k, v in gp.items()}, grads_in)
+
+
+def _torch_grads(fn, t_inputs, t_params, cots):
+    """Same scalar loss in torch; returns (outs, param grads, input grads)."""
+    for t in list(t_inputs) + list(t_params.values()):
+        if t.dtype.is_floating_point:
+            t.requires_grad_(True)
+    outs = fn(t_inputs, t_params)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    loss = sum((o * torch.as_tensor(np.asarray(c))).sum()
+               for o, c in zip(outs, cots))
+    loss.backward()
+    return ([o.detach().numpy() for o in outs],
+            {k: (v.grad.numpy() if v.grad is not None else None)
+             for k, v in t_params.items()},
+            [(t.grad.numpy() if t.dtype.is_floating_point and
+              t.grad is not None else None) for t in t_inputs])
+
+
+def _align(op_type, attrs, np_inputs, np_params, torch_fn, n_inputs=None,
+           rtol=RTOL, atol=ATOL):
+    import jax.numpy as jnp
+
+    op = op_class_for(op_type)("t", attrs, DataType.DT_FLOAT,
+                               num_inputs=n_inputs or len(np_inputs))
+    out_shapes = op.infer_output_shapes(
+        [tuple(np.asarray(a).shape) for a in np_inputs])
+    rng = np.random.default_rng(7)
+    cots = [rng.normal(size=s).astype(np.float32) for s in out_shapes]
+
+    ff_outs, ff_gp, ff_gi = _run_with_grads(
+        op_type, attrs, np_inputs, {k: jnp.asarray(v)
+                                    for k, v in np_params.items()},
+        cots, n_inputs=n_inputs)
+    t_inputs = [torch.as_tensor(np.asarray(a).copy()) for a in np_inputs]
+    t_params = {k: torch.as_tensor(v.copy()) for k, v in np_params.items()}
+    th_outs, th_gp, th_gi = _torch_grads(torch_fn, t_inputs, t_params, cots)
+
+    assert len(ff_outs) == len(th_outs)
+    for a, b in zip(ff_outs, th_outs):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                   err_msg=f"{op_type} fwd")
+    for k in np_params:
+        if th_gp[k] is not None:
+            np.testing.assert_allclose(ff_gp[k], th_gp[k], rtol=rtol,
+                                       atol=atol, err_msg=f"{op_type} d{k}")
+    for i, g in enumerate(th_gi):
+        if g is not None and ff_gi[i] is not None:
+            np.testing.assert_allclose(ff_gi[i], g, rtol=rtol, atol=atol,
+                                       err_msg=f"{op_type} dinput{i}")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_linear_align(rng):
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 5)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    _align(OperatorType.OP_LINEAR,
+           {"out_dim": 5, "activation": ActiMode.AC_MODE_RELU,
+            "use_bias": True},
+           [x], {"kernel": w, "bias": b},
+           lambda ins, p: torch.relu(ins[0] @ p["kernel"] + p["bias"]))
+
+
+def test_conv2d_align(rng):
+    x = rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    k = rng.normal(size=(3, 3, 3, 4)).astype(np.float32)  # HWIO
+    b = rng.normal(size=(4,)).astype(np.float32)
+    _align(OperatorType.OP_CONV2D,
+           {"out_channels": 4, "kernel_h": 3, "kernel_w": 3, "stride_h": 2,
+            "stride_w": 2, "padding_h": 1, "padding_w": 1, "use_bias": True,
+            "activation": ActiMode.AC_MODE_NONE},
+           [x], {"kernel": k, "bias": b},
+           lambda ins, p: torch.nn.functional.conv2d(
+               ins[0], p["kernel"].permute(3, 2, 0, 1), p["bias"],
+               stride=2, padding=1), rtol=1e-3, atol=1e-4)
+
+
+def test_pool2d_align(rng):
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    from flexflow_tpu.ffconst import PoolType
+    _align(OperatorType.OP_POOL2D,
+           {"kernel_h": 2, "kernel_w": 2, "stride_h": 2, "stride_w": 2,
+            "padding_h": 0, "padding_w": 0, "pool_type": PoolType.POOL_MAX,
+            "activation": ActiMode.AC_MODE_NONE},
+           [x], {}, lambda ins, p: torch.nn.functional.max_pool2d(ins[0], 2))
+    _align(OperatorType.OP_POOL2D,
+           {"kernel_h": 2, "kernel_w": 2, "stride_h": 2, "stride_w": 2,
+            "padding_h": 0, "padding_w": 0, "pool_type": PoolType.POOL_AVG,
+            "activation": ActiMode.AC_MODE_NONE},
+           [x], {}, lambda ins, p: torch.nn.functional.avg_pool2d(ins[0], 2))
+
+
+def test_embedding_align(rng):
+    idx = rng.integers(0, 10, size=(4, 6)).astype(np.int32)
+    w = rng.normal(size=(10, 5)).astype(np.float32)
+    _align(OperatorType.OP_EMBEDDING,
+           {"num_entries": 10, "out_dim": 5, "aggr": AggrMode.AGGR_MODE_NONE},
+           [idx], {"weight": w},
+           lambda ins, p: torch.nn.functional.embedding(ins[0].long(),
+                                                        p["weight"]))
+
+
+def test_embedding_bag_align(rng):
+    """aggr sum/avg — the DLRM embedding-bag path (src/ops/embedding.cc)."""
+    idx = rng.integers(0, 10, size=(4, 6)).astype(np.int32)
+    w = rng.normal(size=(10, 5)).astype(np.float32)
+    for aggr, mode in [(AggrMode.AGGR_MODE_SUM, "sum"),
+                       (AggrMode.AGGR_MODE_AVG, "mean")]:
+        _align(OperatorType.OP_EMBEDDING,
+               {"num_entries": 10, "out_dim": 5, "aggr": aggr},
+               [idx], {"weight": w},
+               lambda ins, p, m=mode: torch.nn.functional.embedding_bag(
+                   ins[0].long(), p["weight"], mode=m))
+
+
+def test_layernorm_align(rng):
+    x = rng.normal(size=(4, 6, 16)).astype(np.float32)
+    g = rng.normal(size=(16,)).astype(np.float32)
+    b = rng.normal(size=(16,)).astype(np.float32)
+    _align(OperatorType.OP_LAYERNORM, {"axes": [2]}, [x],
+           {"scale": g, "bias": b},
+           lambda ins, p: torch.nn.functional.layer_norm(
+               ins[0], (16,), p["scale"], p["bias"], eps=1e-5))
+
+
+def test_batchnorm_align(rng):
+    x = rng.normal(size=(4, 3, 5, 5)).astype(np.float32)
+    g = rng.normal(size=(3,)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    _align(OperatorType.OP_BATCHNORM, {"relu": False}, [x],
+           {"scale": g, "bias": b},
+           lambda ins, p: torch.nn.functional.batch_norm(
+               ins[0], None, None, p["scale"], p["bias"], training=True,
+               eps=1e-5), rtol=1e-3, atol=1e-4)
+
+
+def test_batch_matmul_align(rng):
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+    _align(OperatorType.OP_BATCHMATMUL, {}, [a, b], {},
+           lambda ins, p: torch.bmm(ins[0], ins[1]))
+
+
+def test_softmax_align(rng):
+    x = rng.normal(size=(4, 10)).astype(np.float32)
+    _align(OperatorType.OP_SOFTMAX, {"axis": -1}, [x], {},
+           lambda ins, p: torch.softmax(ins[0], dim=-1))
+
+
+def test_concat_split_align(rng):
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 4)).astype(np.float32)
+    _align(OperatorType.OP_CONCAT, {"axis": 1}, [a, b], {},
+           lambda ins, p: torch.cat(ins, dim=1))
+    x = rng.normal(size=(2, 7)).astype(np.float32)
+    _align(OperatorType.OP_SPLIT, {"axis": 1, "sizes": [3, 4]}, [x], {},
+           lambda ins, p: list(torch.split(ins[0], [3, 4], dim=1)))
+
+
+def test_gather_align(rng):
+    x = rng.normal(size=(3, 5)).astype(np.float32)
+    idx = rng.integers(0, 5, size=(3, 2)).astype(np.int32)
+    _align(OperatorType.OP_GATHER, {"dim": 1}, [x, idx], {},
+           lambda ins, p: torch.gather(ins[0], 1, ins[1].long()))
+
+
+def test_elementwise_binary_align(rng):
+    a = rng.normal(size=(4, 5)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32) + 2.0
+    cases = [(OperatorType.OP_EW_ADD, lambda x, y: x + y),
+             (OperatorType.OP_EW_SUB, lambda x, y: x - y),
+             (OperatorType.OP_EW_MUL, lambda x, y: x * y),
+             (OperatorType.OP_EW_DIV, lambda x, y: x / y),
+             (OperatorType.OP_EW_MAX, torch.maximum),
+             (OperatorType.OP_EW_MIN, torch.minimum)]
+    for op_type, tf in cases:
+        _align(op_type, {}, [a, b], {},
+               lambda ins, p, tf=tf: tf(ins[0], ins[1]))
+
+
+def test_elementwise_unary_align(rng):
+    x = (rng.normal(size=(4, 5)).astype(np.float32)) * 0.9 + 1.5  # >0 for log
+    cases = [(OperatorType.OP_EXP, torch.exp),
+             (OperatorType.OP_LOG, torch.log),
+             (OperatorType.OP_SIN, torch.sin),
+             (OperatorType.OP_COS, torch.cos),
+             (OperatorType.OP_RELU, torch.relu),
+             (OperatorType.OP_SIGMOID, torch.sigmoid),
+             (OperatorType.OP_TANH, torch.tanh),
+             (OperatorType.OP_RSQRT, torch.rsqrt),
+             (OperatorType.OP_GELU,
+              lambda t: torch.nn.functional.gelu(t, approximate="tanh"))]
+    for op_type, tf in cases:
+        _align(op_type, {}, [x], {}, lambda ins, p, tf=tf: tf(ins[0]),
+               rtol=1e-3, atol=1e-4)
+
+
+def test_scalar_ops_align(rng):
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    cases = [(OperatorType.OP_SCALAR_MULTIPLY, {"scalar": 2.5},
+              lambda t: t * 2.5),
+             (OperatorType.OP_SCALAR_ADD, {"scalar": 1.5}, lambda t: t + 1.5),
+             (OperatorType.OP_SCALAR_SUB, {"scalar": 0.5}, lambda t: t - 0.5),
+             (OperatorType.OP_SCALAR_TRUE_DIV, {"scalar": 3.0},
+              lambda t: t / 3.0),
+             (OperatorType.OP_POW, {"exponent": 2.0}, lambda t: t ** 2.0)]
+    for op_type, attrs, tf in cases:
+        _align(op_type, attrs, [x], {}, lambda ins, p, tf=tf: tf(ins[0]))
+
+
+def test_reduce_transpose_align(rng):
+    x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    _align(OperatorType.OP_REDUCE_SUM, {"axes": [1], "keepdims": False},
+           [x], {}, lambda ins, p: ins[0].sum(dim=1))
+    _align(OperatorType.OP_MEAN, {"axes": [2], "dims": [2],
+                                  "keepdims": False},
+           [x], {}, lambda ins, p: ins[0].mean(dim=2))
+    _align(OperatorType.OP_TRANSPOSE, {"perm": [2, 0, 1]}, [x], {},
+           lambda ins, p: ins[0].permute(2, 0, 1))
+    _align(OperatorType.OP_RESHAPE, {"shape": [3, 20]}, [x], {},
+           lambda ins, p: ins[0].reshape(3, 20))
+
+
+def test_multihead_attention_align(rng):
+    """Full MHA op (projections + core) vs the identical torch einsum chain —
+    exercises scaled-dot-product, softmax, and all four projection grads
+    (reference analog: tests/align mt5 encoder attention)."""
+    b, s, d, h, k = 2, 6, 8, 2, 4
+    x = rng.normal(size=(b, s, d)).astype(np.float32) * 0.5
+    wq = rng.normal(size=(d, h, k)).astype(np.float32) * 0.3
+    wk = rng.normal(size=(d, h, k)).astype(np.float32) * 0.3
+    wv = rng.normal(size=(d, h, k)).astype(np.float32) * 0.3
+    wo = rng.normal(size=(h, k, d)).astype(np.float32) * 0.3
+    bo = rng.normal(size=(d,)).astype(np.float32)
+
+    def torch_mha(ins, p):
+        q = torch.einsum("bsd,dhk->bhsk", ins[0], p["wq"])
+        kk = torch.einsum("bsd,dhk->bhsk", ins[1], p["wk"])
+        v = torch.einsum("bsd,dhk->bhsk", ins[2], p["wv"])
+        logits = torch.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(k)
+        probs = torch.softmax(logits, dim=-1)
+        out = torch.einsum("bhqk,bhkd->bhqd", probs, v)
+        return torch.einsum("bhsv,hvd->bsd", out, p["wo"]) + p["bo"]
+
+    _align(OperatorType.OP_MULTIHEAD_ATTENTION,
+           {"embed_dim": d, "num_heads": h, "dropout": 0.0, "bias": True,
+            "use_flash": False},
+           [x, x, x], {"wq": wq, "wk": wk, "wv": wv, "wo": wo, "bo": bo},
+           torch_mha, rtol=1e-3, atol=1e-4)
+
+
+def test_lstm_align(rng):
+    """LSTM fwd + grads (incl. through lax.scan) vs torch.nn.LSTM — the
+    autodiff-through-scan path the reference hand-writes in nmt/lstm.cu.
+    Mapping: wx = w_ih.T, wh = w_hh.T, bias = b_ih + b_hh (same i,f,g,o
+    gate order)."""
+    b, s, d, h = 2, 5, 4, 3
+    x = rng.normal(size=(b, s, d)).astype(np.float32) * 0.5
+    wx = rng.normal(size=(d, 4 * h)).astype(np.float32) * 0.4
+    wh = rng.normal(size=(h, 4 * h)).astype(np.float32) * 0.4
+    bias = rng.normal(size=(4 * h,)).astype(np.float32) * 0.1
+
+    # gradient alignment needs autograd to reach the SAME tensors being
+    # compared, so the recurrence is written out with p directly (torch.nn.LSTM
+    # would detach via Parameter copies); the real torch.nn.LSTM is checked
+    # forward-only below
+    def torch_lstm_manual(ins, p):
+        xx = ins[0]
+        h_t = torch.zeros(b, h)
+        c_t = torch.zeros(b, h)
+        ys = []
+        for t in range(s):
+            gates = xx[:, t] @ p["wx"] + h_t @ p["wh"] + p["bias"]
+            i, f, g, o = torch.split(gates, h, dim=-1)
+            c_t = torch.sigmoid(f) * c_t + torch.sigmoid(i) * torch.tanh(g)
+            h_t = torch.sigmoid(o) * torch.tanh(c_t)
+            ys.append(h_t)
+        return [torch.stack(ys, dim=1), torch.cat([h_t, c_t], dim=-1)]
+
+    _align(OperatorType.OP_LSTM, {"hidden_size": h}, [x],
+           {"wx": wx, "wh": wh, "bias": bias}, torch_lstm_manual,
+           rtol=1e-3, atol=1e-4)
+
+    # and forward-only vs the real torch.nn.LSTM as a semantics cross-check
+    import jax
+    op = op_class_for(OperatorType.OP_LSTM)("t", {"hidden_size": h},
+                                            DataType.DT_FLOAT, num_inputs=1)
+    ctx = OpContext(training=False, rng=jax.random.PRNGKey(0))
+    ys, final = op.forward({"wx": wx, "wh": wh, "bias": bias}, [x], ctx)
+    lstm = torch.nn.LSTM(d, h, batch_first=True)
+    with torch.no_grad():
+        lstm.weight_ih_l0.copy_(torch.as_tensor(wx.T))
+        lstm.weight_hh_l0.copy_(torch.as_tensor(wh.T))
+        lstm.bias_ih_l0.copy_(torch.as_tensor(bias))
+        lstm.bias_hh_l0.zero_()
+        t_ys, (t_h, t_c) = lstm(torch.as_tensor(x))
+    np.testing.assert_allclose(np.asarray(ys), t_ys.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(final), torch.cat([t_h[0], t_c[0]], -1).numpy(),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_loss_align(rng):
+    """Loss values + dLoss/dlogits vs torch (reference: loss seeds,
+    src/loss_functions/loss_functions.cc:41)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.execution.losses import loss_value
+
+    logits = rng.normal(size=(8, 5)).astype(np.float32)
+    labels_i = rng.integers(0, 5, size=(8,)).astype(np.int32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    probs = probs.astype(np.float32)
+
+    # sparse CCE: our loss takes softmax probs (final op is softmax)
+    ffv, ffg = jax.value_and_grad(
+        lambda p: loss_value(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                             p, jnp.asarray(labels_i)))(jnp.asarray(probs))
+    tp = torch.as_tensor(probs.copy()).requires_grad_(True)
+    tv = torch.nn.functional.nll_loss(torch.log(tp),
+                                      torch.as_tensor(labels_i).long())
+    tv.backward()
+    np.testing.assert_allclose(float(ffv), float(tv), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ffg), tp.grad.numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+    # MSE
+    y = rng.normal(size=(8, 5)).astype(np.float32)
+    ffv, ffg = jax.value_and_grad(
+        lambda p: loss_value(LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                             p, jnp.asarray(y)))(jnp.asarray(logits))
+    tp = torch.as_tensor(logits.copy()).requires_grad_(True)
+    tv = torch.nn.functional.mse_loss(tp, torch.as_tensor(y))
+    tv.backward()
+    np.testing.assert_allclose(float(ffv), float(tv), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ffg), tp.grad.numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_mlp_end_to_end_grad_align(rng):
+    """Whole-model gradient alignment: 2-layer MLP through FFModel.compile vs
+    the identical torch module — validates the executor's backward pass, not
+    just per-op math."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.execution.losses import loss_value
+
+    bsz, din, dh, dout = 8, 12, 16, 5
+    x = rng.normal(size=(bsz, din)).astype(np.float32)
+    labels = rng.integers(0, dout, size=(bsz,)).astype(np.int32)
+
+    config = FFConfig()
+    config.batch_size = bsz
+    ff = FFModel(config)
+    t = ff.create_tensor((bsz, din), name="x")
+    t1 = ff.dense(t, dh, ActiMode.AC_MODE_RELU, name="fc1")
+    t2 = ff.dense(t1, dout, name="fc2")
+    ff.softmax(t2)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    # copy FF's initialized weights into torch
+    params = jax.tree.map(np.asarray, ff.params)
+    (fc1_name,) = [k for k in params if "fc1" in k]
+    (fc2_name,) = [k for k in params if "fc2" in k]
+    tw1 = torch.as_tensor(params[fc1_name]["kernel"]).requires_grad_(True)
+    tb1 = torch.as_tensor(params[fc1_name]["bias"]).requires_grad_(True)
+    tw2 = torch.as_tensor(params[fc2_name]["kernel"]).requires_grad_(True)
+    tb2 = torch.as_tensor(params[fc2_name]["bias"]).requires_grad_(True)
+
+    fwd = ff.executor.make_forward()
+
+    def ff_loss(p):
+        probs = fwd(p, [jnp.asarray(x)])
+        return loss_value(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                          probs, jnp.asarray(labels))
+
+    ffv, ffg = jax.value_and_grad(ff_loss)(ff.params)
+
+    tx = torch.as_tensor(x)
+    h = torch.relu(tx @ tw1 + tb1)
+    tlogits = h @ tw2 + tb2
+    tloss = torch.nn.functional.cross_entropy(
+        tlogits, torch.as_tensor(labels).long())
+    tloss.backward()
+
+    np.testing.assert_allclose(float(ffv), float(tloss), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ffg[fc1_name]["kernel"]),
+                               tw1.grad.numpy(), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ffg[fc2_name]["kernel"]),
+                               tw2.grad.numpy(), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ffg[fc2_name]["bias"]),
+                               tb2.grad.numpy(), rtol=1e-3, atol=1e-5)

@@ -150,4 +150,7 @@ def test_nmt_trains_and_learns():
         params, opt_state, loss, _ = step(
             params, opt_state, [src, tgt_in], labels, key)
         losses.append(float(loss))
+    # write back: the step donates its inputs, so ff's old buffers are
+    # deleted on TPU (nmt.py docstring documents this pattern)
+    ff.params, ff.opt_state = params, opt_state
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
