@@ -40,7 +40,8 @@ def main():
     import jax
     import numpy as np
 
-    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, \
+        LossType
     from flexflow_tpu.models.bert import (BertConfig, bert_train_flops_per_step,
                                           build_bert)
 
@@ -55,6 +56,8 @@ def main():
 
     config = FFConfig()
     config.batch_size = cfg.batch_size
+    if on_tpu:  # bf16 on the MXU, float32 master weights + loss
+        config.compute_dtype = DataType.DT_BFLOAT16
     ff = FFModel(config)
     build_bert(ff, cfg)
     ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),

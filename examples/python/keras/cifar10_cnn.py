@@ -1,0 +1,44 @@
+"""Keras-frontend CIFAR-10 CNN (reference: examples/python/keras/
+seq_cifar10_cnn.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Activation, Conv2D, Dense,  # noqa: E402
+                                          Flatten, Input, MaxPooling2D,
+                                          Sequential)
+
+
+def main(argv=None):
+    model = Sequential([
+        Input(shape=(3, 32, 32)),
+        Conv2D(32, (3, 3), padding="same", activation="relu"),
+        Conv2D(32, (3, 3), padding="same", activation="relu"),
+        MaxPooling2D((2, 2)),
+        Conv2D(64, (3, 3), padding="same", activation="relu"),
+        Conv2D(64, (3, 3), padding="same", activation="relu"),
+        MaxPooling2D((2, 2)),
+        Flatten(),
+        Dense(512, activation="relu"),
+        Dense(10),
+        Activation("softmax"),
+    ])
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    bs = model.ffconfig.batch_size
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(bs * 2, 3, 32, 32)).astype(np.float32)
+    y = rng.integers(0, 10, size=(bs * 2,)).astype(np.int32)
+    perf = model.fit(x, y, epochs=model.ffconfig.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return model, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

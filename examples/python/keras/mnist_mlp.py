@@ -1,0 +1,37 @@
+"""Keras-frontend MNIST MLP (reference: examples/python/keras/seq_mnist_mlp.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Activation, Dense, Input,  # noqa: E402
+                                          Sequential)
+
+
+def main(argv=None):
+    model = Sequential([
+        Input(shape=(784,)),
+        Dense(512, activation="relu"),
+        Dense(512, activation="relu"),
+        Dense(10),
+        Activation("softmax"),
+    ])
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+
+    bs = model.ffconfig.batch_size
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(bs * 4, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(bs * 4,)).astype(np.int32)
+    perf = model.fit(x, y, epochs=model.ffconfig.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return model, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,55 @@
+"""Shared runner for the native examples (reference: examples/python/native/
+scripts each build a model, create dataloaders, and call fit; synthetic data
+when no --dataset is given, README.md:73)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel,  # noqa: E402
+                          LossType, MetricsType, SGDOptimizer)
+
+
+def synthetic_classification(input_shapes, num_classes, num_samples, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = [rng.normal(size=(num_samples,) + tuple(s)).astype(np.float32)
+          for s in input_shapes]
+    y = rng.integers(0, num_classes, size=(num_samples,)).astype(np.int32)
+    return xs, y
+
+
+def run(build_fn, input_shapes, num_classes, *, optimizer="sgd",
+        loss=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        int_inputs=(), vocab_sizes=None, epochs=None, argv=None):
+    """Build via build_fn(ff) -> final tensor, then train on synthetic data.
+
+    int_inputs: indices of inputs that are integer id tensors (embeddings);
+    vocab_sizes maps those indices to vocabulary sizes.
+    """
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    ff = FFModel(config)
+    build_fn(ff)
+    opt = (AdamOptimizer(ff, alpha=1e-3) if optimizer == "adam"
+           else SGDOptimizer(ff, lr=0.01))
+    ff.compile(optimizer=opt, loss_type=loss,
+               metrics=[MetricsType.METRICS_ACCURACY,
+                        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+
+    num_samples = config.batch_size * 4
+    xs, y = synthetic_classification(input_shapes, num_classes, num_samples)
+    rng = np.random.default_rng(1)
+    for i in int_inputs:
+        hi = (vocab_sizes or {}).get(i, 1000)
+        xs[i] = rng.integers(0, hi, size=xs[i].shape[:-1] if xs[i].shape[-1]
+                             == 1 else xs[i].shape).astype(np.int32)
+    perf = ff.fit(xs if len(xs) > 1 else xs[0], y,
+                  epochs=epochs or config.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f} "
+          f"({perf.train_correct}/{perf.train_all})")
+    return ff, perf

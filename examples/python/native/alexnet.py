@@ -1,0 +1,15 @@
+"""AlexNet on CIFAR-10-shaped synthetic data (reference:
+examples/python/native/alexnet.py + bootcamp_demo/ff_alexnet_cifar10.py)."""
+from _common import run
+from flexflow_tpu.models import build_alexnet_cifar10
+
+
+def main(argv=None):
+    return run(lambda ff: build_alexnet_cifar10(ff, ff.config.batch_size),
+               [(3, 32, 32)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,37 @@
+"""BERT-Large proxy (reference: examples/python/native/bert_proxy_native.py:
+12-17 — seq 512, hidden 1024, 16 heads, 24 layers; random data). Pass
+--compute-dtype bf16 for the TPU mixed-precision path."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType)
+from flexflow_tpu.models import BertConfig, build_bert
+
+
+def main(argv=None, cfg=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    cfg = cfg or BertConfig(batch_size=config.batch_size)
+    config.batch_size = cfg.batch_size
+    ff = FFModel(config)
+    build_bert(ff, cfg)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+
+    n = cfg.batch_size * 2
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, cfg.seq_len, cfg.hidden)).astype(np.float32)
+    y = rng.integers(0, cfg.num_classes, size=(n,)).astype(np.int32)
+    perf = ff.fit(x, y)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

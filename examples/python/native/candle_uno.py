@@ -1,0 +1,36 @@
+"""CANDLE-Uno drug-response model (reference: examples/cpp/candle_uno/
+candle_uno.cc — per-feature dense towers concatenated into a deep MLP)."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_candle_uno
+from flexflow_tpu.models.misc import _UNO_FEATURE_SHAPES, _UNO_INPUT_FEATURES
+
+
+def main(argv=None, dense_layers=(1024,) * 2, dense_feature_layers=(1024,) * 2):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    ff = FFModel(config)
+    bs = config.batch_size
+    build_candle_uno(ff, bs, dense_layers=dense_layers,
+                     dense_feature_layers=dense_feature_layers)
+    n = bs * 2
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(n, _UNO_FEATURE_SHAPES[f])).astype(np.float32)
+          for f in _UNO_INPUT_FEATURES.values()]
+    y = rng.uniform(0, 1, size=(n, 1)).astype(np.float32)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    perf = ff.fit(xs, y)
+    print(f"train mse = {perf.mean('mse_loss'):.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,43 @@
+"""DLRM (reference: examples/python/native/dlrm.py, examples/cpp/DLRM) —
+embedding bags + bottom/top MLPs + feature interaction, MSE loss on a scalar
+click prediction."""
+import numpy as np
+
+import _common  # noqa: F401  (sys.path side effect)
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_dlrm
+
+
+def main(argv=None, embedding_sizes=(1000,) * 8, embedding_dim=64,
+         mlp_bot=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    ff = FFModel(config)
+    bs = config.batch_size
+    # bottom MLP must end at embedding_dim (the interaction reshape
+    # concatenates per-feature embedding_dim vectors, dlrm.cc)
+    mlp_bot = mlp_bot or (512, 256, embedding_dim)
+    sparse_inputs, dense_input, _out = build_dlrm(
+        ff, bs, embedding_sizes=embedding_sizes,
+        embedding_dim=embedding_dim, mlp_bot=mlp_bot)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+
+    n = bs * 4
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, sz, size=(n, 1)).astype(np.int64)
+          for sz in embedding_sizes]
+    xs.append(rng.normal(size=(n, 16)).astype(np.float32))
+    y = rng.uniform(0, 1, size=(n, 1)).astype(np.float32)
+    perf = ff.fit(xs, y)
+    print(f"train mse = {perf.mean('mse_loss'):.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,16 @@
+"""InceptionV3 (reference: examples/python/native/inception.py,
+examples/cpp/InceptionV3)."""
+from _common import run
+from flexflow_tpu.models import build_inception_v3
+
+
+def main(argv=None, num_classes=1000):
+    return run(lambda ff: build_inception_v3(ff, ff.config.batch_size,
+                                             num_classes=num_classes),
+               [(3, 299, 299)], num_classes, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,33 @@
+"""MLP_Unify (reference: examples/cpp/MLP_Unify/mlp.cc — two 8x8192 dense
+towers summed; the OSDI'22 MLP benchmark)."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_mlp_unify
+
+
+def main(argv=None, hidden_dims=(8192,) * 8, input_dim=1024):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    ff = FFModel(config)
+    bs = config.batch_size
+    build_mlp_unify(ff, bs, input_dim=input_dim, hidden_dims=hidden_dims)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    n = bs * 2
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(n, input_dim)).astype(np.float32) for _ in range(2)]
+    y = rng.integers(0, hidden_dims[-1], size=(n,)).astype(np.int32)
+    perf = ff.fit(xs, y)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,26 @@
+"""MNIST MLP — the minimum end-to-end slice (reference:
+examples/python/native/mnist_mlp.py: dense 512/512/10 + softmax, SGD,
+sparse-CCE)."""
+import numpy as np
+
+from _common import run  # noqa: E402  (sys.path set up by _common)
+from flexflow_tpu import ActiMode
+
+
+def build(ff, batch_size=64):
+    x = ff.create_tensor((batch_size, 784), name="mnist_input")
+    t = ff.dense(x, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    return x, ff.softmax(t)
+
+
+def main(argv=None):
+    return run(lambda ff: build(ff, ff.config.batch_size),
+               [(784,)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

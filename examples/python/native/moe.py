@@ -1,0 +1,16 @@
+"""Mixture-of-experts MLP (reference: examples/cpp/mixture_of_experts/moe.cc:
+gate dense -> top_k -> group_by -> per-expert dense -> aggregate, with
+load-balance loss lambda_bal)."""
+from _common import run
+from flexflow_tpu.models import build_moe_mlp
+
+
+def main(argv=None):
+    return run(lambda ff: build_moe_mlp(ff, ff.config.batch_size),
+               [(784,)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

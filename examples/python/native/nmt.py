@@ -1,0 +1,42 @@
+"""LSTM NMT seq2seq (reference: nmt/ — embed -> 2-layer LSTM encoder/decoder
+-> per-token softmax)."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.models import NMTConfig, build_nmt
+
+
+def main(argv=None, cfg=None):
+    import jax.random as jrandom
+
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    cfg = cfg or NMTConfig(batch_size=config.batch_size)
+    config.batch_size = cfg.batch_size
+    ff = FFModel(config)
+    build_nmt(ff, cfg)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, cfg.src_vocab,
+                       size=(cfg.batch_size, cfg.src_len)).astype(np.int32)
+    tgt = rng.integers(0, cfg.tgt_vocab,
+                       size=(cfg.batch_size, cfg.tgt_len)).astype(np.int32)
+    labels = tgt.reshape(-1)  # per-token labels: (batch*tgt_len,)
+    step = ff.executor.make_train_step()
+    params, opt_state = ff.params, ff.opt_state
+    for i in range(4):
+        params, opt_state, loss, _ = step(params, opt_state, [src, tgt],
+                                          labels, jrandom.PRNGKey(i))
+        print(f"step {i}: loss={float(loss):.4f}")
+    ff.params, ff.opt_state = params, opt_state
+    return ff
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

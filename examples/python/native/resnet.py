@@ -1,0 +1,20 @@
+"""ResNet-50 (reference: examples/python/native/resnet.py,
+examples/cpp/ResNet). Synthetic ImageNet-shaped data; use --batch-size to
+scale."""
+from _common import run
+from flexflow_tpu.models import build_resnet50
+
+
+def main(argv=None, image_size=64, num_classes=200):
+    # default 64px synthetic images keep the smoke run fast; pass
+    # image_size=224 for the full config
+    return run(lambda ff: build_resnet50(ff, ff.config.batch_size,
+                                         image_size=image_size,
+                                         num_classes=num_classes),
+               [(3, image_size, image_size)], num_classes, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:], image_size=224, num_classes=1000)

@@ -1,0 +1,16 @@
+"""ResNeXt-50 (reference: examples/cpp/resnext50)."""
+from _common import run
+from flexflow_tpu.models import build_resnext50
+
+
+def main(argv=None, image_size=64, num_classes=200):
+    return run(lambda ff: build_resnext50(ff, ff.config.batch_size,
+                                          image_size=image_size,
+                                          num_classes=num_classes),
+               [(3, image_size, image_size)], num_classes, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:], image_size=224, num_classes=1000)

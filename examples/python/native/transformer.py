@@ -1,0 +1,24 @@
+"""Transformer encoder (reference: examples/cpp/Transformer/transformer.cc:
+79-85 — 12 layers, hidden 1024, 16 heads, seq 512)."""
+import _common  # noqa: F401
+from _common import run
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+
+def main(argv=None, cfg=None):
+    c = [cfg]
+
+    def build(ff):
+        c[0] = cfg or TransformerConfig(batch_size=ff.config.batch_size)
+        ff.config.batch_size = c[0].batch_size
+        return build_transformer(ff, c[0])
+
+    cfg0 = cfg or TransformerConfig()
+    return run(build, [(cfg0.seq_len, cfg0.hidden)], 2, optimizer="adam",
+               argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,34 @@
+"""XDL CTR model (reference: examples/cpp/XDL/xdl.cc — embedding bags
+concatenated into an MLP; OSDI'22 xdl benchmark)."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_xdl
+
+
+def main(argv=None, num_embeddings=4, vocab_size=100000):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    ff = FFModel(config)
+    bs = config.batch_size
+    build_xdl(ff, bs, num_embeddings=num_embeddings, vocab_size=vocab_size)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    n = bs * 2
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, vocab_size, size=(n, 1)).astype(np.int32)
+          for _ in range(num_embeddings)]
+    y = rng.uniform(0, 1, size=(n, 1)).astype(np.float32)
+    perf = ff.fit(xs, y)
+    print(f"train mse = {perf.mean('mse_loss'):.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

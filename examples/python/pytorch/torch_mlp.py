@@ -1,0 +1,51 @@
+"""PyTorch-frontend example (reference: examples/python/pytorch/ — trace a
+torch.nn.Module via torch.fx and train it in the framework)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,  # noqa: E402
+                          SGDOptimizer)
+from flexflow_tpu.frontends.torch_fx import (PyTorchModel,  # noqa: E402
+                                             copy_torch_weights)
+
+
+class MLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 512)
+        self.fc2 = torch.nn.Linear(512, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.fc1(x))
+        return torch.softmax(self.fc2(x), dim=-1)
+
+
+def main(argv=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    ff = FFModel(config)
+    bs = config.batch_size
+    x_t = ff.create_tensor((bs, 784))
+    PyTorchModel(MLP()).torch_to_ff(ff, [x_t])
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    copy_torch_weights(ff)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(bs * 4, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(bs * 4,)).astype(np.int32)
+    perf = ff.fit(x, y, epochs=config.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

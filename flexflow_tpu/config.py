@@ -12,7 +12,7 @@ import os
 import sys
 from typing import List, Optional, Sequence
 
-from .ffconst import CompMode
+from .ffconst import CompMode, DataType
 
 
 @dataclasses.dataclass
@@ -88,6 +88,10 @@ class FFConfig:
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
     mesh_axis_names: Sequence[str] = ("data", "model")
     allow_mixed_precision: bool = True  # bf16 compute where safe
+    # compute (activation/matmul) dtype for the jitted step; DT_NONE = follow
+    # tensor dtypes. Master weights, loss, and normalization stay float32 —
+    # the standard TPU mixed-precision recipe (bf16 on the MXU).
+    compute_dtype: DataType = DataType.DT_NONE
     seed: int = 42
 
     iteration_config: FFIterationConfig = dataclasses.field(
@@ -160,6 +164,10 @@ class FFConfig:
                 self.search_num_workers = int(_next())
             elif a == "--base-optimize-threshold":
                 self.base_optimize_threshold = int(_next())
+            elif a == "--compute-dtype":
+                from .ffconst import str_to_dtype
+
+                self.compute_dtype = str_to_dtype(_next())
             elif a == "--enable-propagation":
                 pass  # legacy MCMC propagation; accepted for compatibility
             elif a == "--disable-control-replication":

@@ -123,6 +123,47 @@ class Executor:
             return jax.jit(init_fn, out_shardings=shardings)(key)
         return jax.jit(init_fn)(key)
 
+    # --------------------------------------------------------- mixed precision
+    def _compute_jnp_dtype(self):
+        """jnp dtype for forward compute, or None for full precision.
+
+        Master weights, the loss, and normalization statistics stay float32;
+        only the forward/backward compute (matmuls on the MXU) runs in the
+        reduced dtype. The cast happens inside the differentiated function, so
+        gradients flow back to the float32 master params.
+        """
+        cd = getattr(self.config, "compute_dtype", None)
+        if cd is None or cd == DataType.DT_NONE:
+            return None
+        return dtype_to_jnp(cd)
+
+    @staticmethod
+    def _cast_floats(tree, dtype):
+        import jax
+        import jax.numpy as jnp
+
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+
+        return jax.tree.map(cast, tree)
+
+    def _cast_for_compute(self, params, xs):
+        cdtype = self._compute_jnp_dtype()
+        if cdtype is None:
+            return params, xs
+        return (self._cast_floats(params, cdtype),
+                self._cast_floats(xs, cdtype))
+
+    @staticmethod
+    def _logits_f32(logits):
+        import jax.numpy as jnp
+
+        if jnp.issubdtype(logits.dtype, jnp.floating):
+            return logits.astype(jnp.float32)
+        return logits
+
     # ------------------------------------------------------------------ forward
     def forward_outputs(self, params, bound_inputs: Dict[int, Any],
                         ctx: OpContext) -> Dict[int, List[Any]]:
@@ -178,9 +219,10 @@ class Executor:
         opt = self.optimizer
 
         def loss_fn(params, xs, labels, rng):
+            params_c, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[])
-            values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
-            logits = values[self.final_guid][0]
+            values = self.forward_outputs(params_c, self._bind_inputs(xs), ctx)
+            logits = self._logits_f32(values[self.final_guid][0])
             loss = loss_value(self.loss_type, logits, labels,
                               self.repl_labels)
             for aux in ctx.aux_losses:
@@ -216,9 +258,10 @@ class Executor:
         mesh = self.mesh
 
         def estep(params, xs, labels):
+            params, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=False, rng=None, mesh=mesh)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
-            logits = values[self.final_guid][0]
+            logits = self._logits_f32(values[self.final_guid][0])
             loss = loss_value(self.loss_type, logits, labels, self.repl_labels)
             m = self._compute_metrics(logits, labels)
             return loss, m
@@ -235,6 +278,7 @@ class Executor:
         mesh = self.mesh
 
         def fwd(params, xs):
+            params, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=False, rng=None, mesh=mesh)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
             return values[self.final_guid][0]

@@ -210,6 +210,17 @@ def dtype_to_jnp(dt: "DataType"):
     return jnp.dtype(_DTYPE_TO_STR[dt])
 
 
+def str_to_dtype(name: str) -> "DataType":
+    """Parse a dtype name (CLI `--compute-dtype`); accepts common aliases."""
+    name = name.lower()
+    name = {"bf16": "bfloat16", "fp16": "float16", "half": "float16",
+            "fp32": "float32", "float": "float32", "fp64": "float64",
+            "double": "float64"}.get(name, name)
+    if name not in _STR_TO_DTYPE:
+        raise ValueError(f"unsupported dtype {name}")
+    return _STR_TO_DTYPE[name]
+
+
 def jnp_to_dtype(dt) -> "DataType":
     import numpy as np
 

@@ -22,13 +22,36 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
+def _apply_causal_mask(s, q_start, k_start, offset, block_q, block_k):
+    """Causal mask for one (block_q, block_k) score tile. ``offset`` aligns
+    rectangular shapes the same way the einsum core's ``tril(k=sk-sq)`` does:
+    query i attends keys j with j <= i + offset."""
+    import jax
+    import jax.numpy as jnp
+
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+
+
+def _causal_num_kb(q_idx, block_q, block_k, num_kb, offset):
+    """Number of leading key blocks that contribute to query block q_idx."""
+    import jax.numpy as jnp
+
+    last = ((q_idx + 1) * block_q + offset + block_k - 1) // block_k
+    return jnp.clip(last, 0, num_kb)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                      seq_k: int, causal: bool, sm_scale: float):
+                      seq_k: int, causal: bool, sm_scale: float,
+                      causal_offset: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * sm_scale  # (block_q, d)
+    q = q_ref[...]  # (block_q, d) — kept in input dtype: bf16 feeds the MXU
     block_q = q.shape[0]
     q_idx = pl.program_id(1)
 
@@ -40,34 +63,34 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_causal_mask(s, q_idx * block_q, kb * block_k,
+                                   causal_offset, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     if causal:
-        # only key blocks up to the diagonal contribute
-        last_kb = ((q_idx + 1) * block_q + block_k - 1) // block_k
-        num_kb_eff = jnp.minimum(num_kb, last_kb)
+        # only key blocks up to the (offset-shifted) diagonal contribute
+        num_kb_eff = _causal_num_kb(q_idx, block_q, block_k, num_kb,
+                                    causal_offset)
         m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m, l, acc))
     else:
         m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    # lse block is (block_q, 1): TPU tiling wants >=2-D blocks whose minor dim
+    # matches the array (a bare (block_q,) slice of (bh, seq) is rejected)
+    lse_ref[...] = (m + jnp.log(l_safe))[:, None].astype(lse_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -88,7 +111,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     grid = (batch * heads, seq_q // block_q)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               seq_k=seq_k, causal=causal, sm_scale=sm_scale)
+                               seq_k=seq_k, causal=causal, sm_scale=sm_scale,
+                               causal_offset=seq_k - seq_q)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -99,16 +123,163 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((batch * heads, seq_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
     return (out.reshape(batch, heads, seq_q, d),
             lse.reshape(batch, heads, seq_q))
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
+                          causal: bool, sm_scale: float,
+                          causal_offset: int = 0):
+    """Grid (batch*heads, seq_k//block_k): one (dk, dv) tile per k block,
+    streaming q/do/lse/delta blocks — the FlashAttention-2 backward split."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    k = k_ref[...]  # (block_k, d)
+    v = v_ref[...]
+    block_k = k.shape[0]
+    d = k.shape[1]
+    kb = pl.program_id(1)
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :]
+        do = do_ref[pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :]  # (bq, 1) f32
+        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _apply_causal_mask(s, qb * block_q, kb * block_k,
+                                   causal_offset, block_q, block_k)
+        p = jnp.exp(s - lse)  # exact softmax probabilities from stored lse
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # first q block with any q_pos + offset >= kb*block_k
+        qb_start = jnp.maximum(kb * block_k - causal_offset, 0) // block_q
+    else:
+        qb_start = 0
+    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, seq_k: int, causal: bool,
+                         sm_scale: float, causal_offset: int = 0):
+    """Grid (batch*heads, seq_q//block_q): one dq tile per q block."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...]  # (block_q, d)
+    do = do_ref[...]
+    lse = lse_ref[...]  # (block_q, 1)
+    delta = delta_ref[...]
+    block_q = q.shape[0]
+    d = q.shape[1]
+    qb = pl.program_id(1)
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _apply_causal_mask(s, qb * block_q, kb * block_k,
+                                   causal_offset, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    if causal:
+        num_kb_eff = _causal_num_kb(qb, block_q, block_k, num_kb,
+                                    causal_offset)
+        dq = jax.lax.fori_loop(0, num_kb_eff, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, num_kb, body, dq)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    batch, heads, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    sm_scale = 1.0 / np.sqrt(d)
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+
+    qr = q.reshape(batch * heads, seq_q, d)
+    kr = k.reshape(batch * heads, seq_k, d)
+    vr = v.reshape(batch * heads, seq_k, d)
+    dor = do.reshape(batch * heads, seq_q, d).astype(q.dtype)
+    lser = lse.reshape(batch * heads, seq_q, 1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(batch * heads, seq_q, 1)
+
+    full_q = pl.BlockSpec((None, seq_q, d), lambda b, i: (b, 0, 0))
+    full_q1 = pl.BlockSpec((None, seq_q, 1), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0))
+    tile_q = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
+    tile_q1 = pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0))
+    tile_k = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0))
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal,
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(batch * heads, seq_k // block_k),
+        in_specs=[full_q, tile_k, tile_k, full_q, full_q1, full_q1],
+        out_specs=[tile_k, tile_k],
+        out_shape=[jax.ShapeDtypeStruct((batch * heads, seq_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((batch * heads, seq_k, d), v.dtype)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal,
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(batch * heads, seq_q // block_q),
+        in_specs=[tile_q, full_k, full_k, tile_q, tile_q1, tile_q1],
+        out_specs=tile_q,
+        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(batch, heads, seq_q, d),
+            dk.reshape(batch, heads, seq_k, d),
+            dv.reshape(batch, heads, seq_k, d))
 
 
 def _reference_core(q, k, v, causal: bool):
@@ -135,10 +306,21 @@ def flash_attention(q, k, v, causal: bool = False,
     """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim).
 
     seq_q/seq_k must be multiples of the block sizes (the attention op checks
-    this before selecting the flash path, ops/attention.py)."""
+    this before selecting the flash path, ops/attention.py). Causal requires
+    seq_q <= seq_k: with more queries than keys the leading queries attend an
+    empty window, which only the einsum core's degenerate uniform-softmax
+    handles — use mha_core for that case."""
+    _check_causal_shape(q, k, causal)
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
                             _resolve_interpret(interpret))
     return out
+
+
+def _check_causal_shape(q, k, causal: bool) -> None:
+    if causal and q.shape[-2] > k.shape[-2]:
+        raise ValueError(
+            f"flash_attention causal requires seq_q <= seq_k, got "
+            f"{q.shape[-2]} > {k.shape[-2]}; use the einsum core instead")
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> bool:
@@ -150,37 +332,19 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    _check_causal_shape(q, k, causal)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               _resolve_interpret(interpret))
     return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, do):
-    """Backward by recompute: with residuals (q,k,v,out,lse) the gradients are
-    computed with the standard flash-attention backward identities; here we use
-    jnp einsums (XLA tiles them) — a Pallas bwd kernel is a later optimization.
-    """
-    import jax
-    import jax.numpy as jnp
-
+    """Backward by recompute (never materializes the score matrix): blockwise
+    Pallas kernels using the flash-attention backward identities, with exact
+    probabilities reconstructed from the stored logsumexp."""
     q, k, v, out, lse = res
-    d = q.shape[-1]
-    sm_scale = 1.0 / np.sqrt(d)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * sm_scale
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])  # exact softmax from stored lse
-    do_f = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do_f)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", do_f, v.astype(jnp.float32))
-    delta = jnp.sum(do_f * out.astype(jnp.float32), axis=-1)  # (b,h,q)
-    ds = p * (dp - delta[..., None]) * sm_scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
+                           _resolve_interpret(interpret))
 
 
 flash_attention.defvjp(_fwd, _bwd)

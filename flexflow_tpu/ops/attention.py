@@ -102,7 +102,7 @@ class MultiHeadAttentionOp(Op):
 
             out = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
                                  causal=causal)
-        elif _should_use_flash(use_flash, q):
+        elif _should_use_flash(use_flash, q, k, causal):
             from ..kernels.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal)
@@ -134,7 +134,9 @@ class MultiHeadAttentionOp(Op):
         }
 
 
-def _should_use_flash(use_flash, q) -> bool:
+def _should_use_flash(use_flash, q, k, causal) -> bool:
+    if causal and q.shape[-2] > k.shape[-2]:
+        return False  # empty attention windows — einsum core only
     if use_flash is True:
         return True
     if use_flash == "auto":
@@ -146,5 +148,5 @@ def _should_use_flash(use_flash, q) -> bool:
             on_tpu = False
         # flash pays off for long seq; block size needs seq % 128 == 0
         return on_tpu and q.shape[-2] >= 1024 and q.shape[-2] % 128 == 0 \
-            and q.shape[-1] % 128 == 0
+            and k.shape[-2] % 128 == 0 and q.shape[-1] % 128 == 0
     return False

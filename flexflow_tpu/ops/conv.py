@@ -158,11 +158,12 @@ class BatchNormOp(Op):
         (x,) = inputs
         eps = self.attrs.get("eps", 1e-5)
         axes = (0, 2, 3) if x.ndim == 4 else (0,)
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.var(x, axis=axes, keepdims=True)
+        xf = x.astype(jnp.float32)  # batch statistics in f32 under bf16 compute
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
         scale = params["scale"].reshape((1, -1) + (1,) * (x.ndim - 2))
         bias = params["bias"].reshape((1, -1) + (1,) * (x.ndim - 2))
-        y = (x - mean) * scale / jnp.sqrt(var + eps) + bias
+        y = ((xf - mean) * scale / jnp.sqrt(var + eps) + bias).astype(x.dtype)
         if self.attrs.get("relu", True):
             import jax.nn as jnn
 

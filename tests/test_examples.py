@@ -1,0 +1,116 @@
+"""Example scripts smoke tier (reference: tests/multi_gpu_tests.sh runs the
+~50 example scripts to completion; here each runs tiny configs on the CPU
+mesh)."""
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "python")
+
+
+def _load(subdir, name):
+    import importlib.util
+
+    path = os.path.join(EXAMPLES, subdir, name + ".py")
+    entry = os.path.join(EXAMPLES, subdir)
+    sys.path.insert(0, entry)
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        # remove the exact entry: executing the module may itself insert at
+        # position 0 (examples/_common.py adds the repo root)
+        sys.path.remove(entry)
+
+
+SMALL = ["-b", "8", "-e", "1"]
+
+
+def test_mnist_mlp():
+    _, perf = _load("native", "mnist_mlp").main(SMALL)
+    assert perf.train_all == 32
+
+
+def test_alexnet_cifar10():
+    _, perf = _load("native", "alexnet").main(SMALL)
+    assert perf.train_all == 32
+
+
+def test_resnet_small():
+    _, perf = _load("native", "resnet").main(["-b", "2", "-e", "1"],
+                                             image_size=32, num_classes=10)
+    assert perf.train_all == 8
+
+
+def test_dlrm():
+    _, perf = _load("native", "dlrm").main(
+        SMALL, embedding_sizes=(50,) * 4, embedding_dim=16)
+    assert perf.train_all == 32
+
+
+def test_moe():
+    _, perf = _load("native", "moe").main(SMALL)
+    assert perf.train_all == 32
+
+
+def test_mlp_unify_small():
+    _, perf = _load("native", "mlp_unify").main(
+        SMALL, hidden_dims=(64, 64, 10), input_dim=32)
+    assert perf.train_all == 16
+
+
+def test_xdl_small():
+    _, perf = _load("native", "xdl").main(SMALL, vocab_size=500)
+    assert perf.train_all == 16
+
+
+def test_candle_uno_small():
+    _, perf = _load("native", "candle_uno").main(
+        SMALL, dense_layers=(64,), dense_feature_layers=(64,))
+    assert perf.train_all == 16
+
+
+def test_transformer_tiny():
+    from flexflow_tpu.models import TransformerConfig
+
+    _, perf = _load("native", "transformer").main(
+        SMALL, cfg=TransformerConfig.tiny(batch_size=8))
+    assert perf.train_all == 32
+
+
+def test_bert_tiny():
+    from flexflow_tpu.models import BertConfig
+
+    _, perf = _load("native", "bert_proxy_native").main(
+        SMALL, cfg=BertConfig.tiny(batch_size=8))
+    assert perf.train_all == 16
+
+
+def test_nmt_tiny():
+    from flexflow_tpu.models import NMTConfig
+
+    _load("native", "nmt").main(SMALL, cfg=NMTConfig.tiny(batch_size=4))
+
+
+def test_keras_mnist():
+    _, perf = _load("keras", "mnist_mlp").main(SMALL)
+    assert perf.accuracy() >= 0.0
+
+
+def test_keras_cifar10_cnn():
+    _, perf = _load("keras", "cifar10_cnn").main(SMALL)
+    assert perf.accuracy() >= 0.0
+
+
+def test_torch_mlp():
+    pytest.importorskip("torch")
+    _, perf = _load("pytorch", "torch_mlp").main(SMALL)
+    assert perf.accuracy() >= 0.0
+
+
+# inception/resnext example wrappers are exercised at tiny scale by
+# tests/test_model_zoo.py (same builders); full-size runs are bench-only.

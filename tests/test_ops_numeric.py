@@ -151,3 +151,41 @@ def test_flash_attention_causal_and_grads(rng):
     gr = jax.grad(f_ref)(q)
     np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                rtol=5e-3, atol=5e-3)
+
+
+def test_flash_attention_causal_rectangular(rng):
+    """Rectangular causal shapes (decode with cached prefix): the mask must
+    align like tril(k=sk-sq), matching the einsum core — fwd AND grads."""
+    import jax
+    import jax.numpy as jnp
+    from flexflow_tpu.kernels.flash_attention import (flash_attention,
+                                                      _reference_core)
+
+    # seq_q > seq_k causal is rejected (empty attention windows)
+    import pytest
+
+    qq = jnp.zeros((1, 1, 256, 64))
+    kk = jnp.zeros((1, 1, 128, 64))
+    with pytest.raises(ValueError, match="seq_q <= seq_k"):
+        flash_attention(qq, kk, kk, True, 64, 64, True)
+
+    for sq, sk in ((128, 256),):
+        q = jnp.asarray(rng.normal(size=(1, 2, sq, 64)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, sk, 64)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, sk, 64)).astype(np.float32))
+        out = flash_attention(q, k, v, True, 64, 64, True)
+        ref = _reference_core(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, 64, 64, True) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(_reference_core(q, k, v, True) ** 2)
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
