@@ -23,12 +23,10 @@ def synthetic_classification(input_shapes, num_classes, num_samples, seed=0):
 
 def run(build_fn, input_shapes, num_classes, *, optimizer="sgd",
         loss=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
-        int_inputs=(), vocab_sizes=None, epochs=None, argv=None):
-    """Build via build_fn(ff) -> final tensor, then train on synthetic data.
-
-    int_inputs: indices of inputs that are integer id tensors (embeddings);
-    vocab_sizes maps those indices to vocabulary sizes.
-    """
+        epochs=None, argv=None):
+    """Build via build_fn(ff) -> final tensor, then train on synthetic
+    float classification data. Models with integer (embedding-id) inputs
+    hand-roll their driver instead (dlrm.py, xdl.py, nmt.py)."""
     config = FFConfig()
     if argv:
         config.parse_args(argv)
@@ -43,11 +41,6 @@ def run(build_fn, input_shapes, num_classes, *, optimizer="sgd",
 
     num_samples = config.batch_size * 4
     xs, y = synthetic_classification(input_shapes, num_classes, num_samples)
-    rng = np.random.default_rng(1)
-    for i in int_inputs:
-        hi = (vocab_sizes or {}).get(i, 1000)
-        xs[i] = rng.integers(0, hi, size=xs[i].shape[:-1] if xs[i].shape[-1]
-                             == 1 else xs[i].shape).astype(np.int32)
     perf = ff.fit(xs if len(xs) > 1 else xs[0], y,
                   epochs=epochs or config.epochs)
     print(f"train accuracy = {perf.accuracy():.4f} "

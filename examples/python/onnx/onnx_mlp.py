@@ -1,0 +1,54 @@
+"""ONNX-frontend example (reference: examples/python/onnx/ — import an .onnx
+graph and train it). Exports a small torch MLP to ONNX first; skips cleanly if
+the onnx package is not installed (it is optional in this image)."""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        print("onnx package not installed — skipping (frontends/onnx.py is "
+              "gated on it)")
+        return None, None
+
+    import torch
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+    from flexflow_tpu.frontends.onnx import ONNXModel
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(784, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10), torch.nn.Softmax(dim=-1))
+    path = os.path.join(tempfile.mkdtemp(), "mlp.onnx")
+    torch.onnx.export(model, torch.zeros(1, 784), path,
+                      input_names=["input"], output_names=["output"])
+
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    ff = FFModel(config)
+    bs = config.batch_size
+    x_t = ff.create_tensor((bs, 784), name="input")
+    ONNXModel(path).apply(ff, {"input": x_t})
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(bs * 2, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(bs * 2,)).astype(np.int32)
+    perf = ff.fit(x, y, epochs=config.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,29 @@
+"""Launcher: ``python -m flexflow_tpu user_script.py [flags]`` — the analog of
+the reference's ``flexflow_python`` driver (python/flexflow/driver.py,
+python/flexflow_python.py), which boots the runtime and then runs the user
+script as the top-level task. Here there is no runtime to boot; the launcher
+just makes the reference-style invocation work unchanged: the script sees the
+remaining argv (picked up by ``FFConfig()``) and the framework on sys.path.
+"""
+import os
+import runpy
+import sys
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m flexflow_tpu <script.py> [flags]\n"
+              "Flags after the script are visible to FFConfig "
+              "(-b, -e, --budget, --only-data-parallel, -ll:tpu N, ...).")
+        return
+    script = argv[0]
+    if not os.path.exists(script):
+        raise SystemExit(f"flexflow_tpu launcher: no such script: {script}")
+    sys.argv = argv  # script name + its flags, reference-style
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,10 +52,18 @@ def batch_iterator(arrays: Sequence[np.ndarray], batch_size: int,
     n = arrays[0].shape[0]
     idx = np.arange(n)
     if shuffle:
-        from ..native import gather_rows  # native multithreaded gather
+        # native double-buffered staging: C++ gathers batch b+1 while batch b
+        # ships to the device (flexflow_tpu/native BatchPipeline; falls back
+        # to synchronous gather without the library)
+        from ..native import BatchPipeline
 
         np.random.default_rng(seed).shuffle(idx)
-        arrays = [np.ascontiguousarray(a) for a in arrays]  # once, not per batch
+        if drop_remainder or n % batch_size == 0:
+            yield from BatchPipeline(arrays, idx, batch_size)
+            return
+        from ..native import gather_rows
+
+        arrays = [np.ascontiguousarray(a) for a in arrays]
         take = gather_rows
     else:
         def take(a, sl):

@@ -64,6 +64,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.pipeline_create.restype = ctypes.c_void_p
+        lib.pipeline_create.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.pipeline_next.restype = ctypes.c_int64
+        lib.pipeline_next.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_void_p)]
+        lib.pipeline_destroy.restype = None
+        lib.pipeline_destroy.argtypes = [ctypes.c_void_p]
+        lib.imm_dominators_native.restype = ctypes.c_int
+        lib.imm_dominators_native.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
         return _lib
 
@@ -121,6 +135,102 @@ def simulate_taskgraph(costs: np.ndarray, device: np.ndarray,
                 "(cycle, bad edge, or device id out of range)")
         return float(r)
     return _simulate_py(costs, device, n_devices, esrc, edst)
+
+
+class BatchPipeline:
+    """Double-buffered shuffled-batch staging with a native gather thread:
+    batch b+1 is assembled in C++ while Python ships batch b to the device
+    (the reference overlaps its zcmem->fbmem batch copy with compute the same
+    way).
+
+    With ``copy=True`` (default) each yielded batch is an owned array, safe to
+    retain. ``copy=False`` yields zero-copy views into the native double
+    buffer — only valid until the next batch is pulled and only for consumers
+    that ship the batch to the device before advancing.
+
+    Falls back to synchronous numpy gather when the native library is
+    unavailable."""
+
+    def __init__(self, arrays, indices: np.ndarray, batch_size: int,
+                 n_threads: int = 4, copy: bool = True):
+        self.copy = copy
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        self.indices = np.ascontiguousarray(indices, dtype=np.int64)
+        self.batch_size = int(batch_size)
+        self.num_batches = len(self.indices) // self.batch_size
+        self._lib = get_lib()
+        self._h = None
+        if self._lib is not None and self.num_batches > 0:
+            n = len(self.arrays)
+            self._src_ptrs = (ctypes.c_void_p * n)(
+                *[a.ctypes.data_as(ctypes.c_void_p).value
+                  for a in self.arrays])
+            self._row_bytes = (ctypes.c_int64 * n)(
+                *[a.dtype.itemsize * int(np.prod(a.shape[1:], initial=1))
+                  for a in self.arrays])
+            self._h = self._lib.pipeline_create(
+                n, self._src_ptrs, self._row_bytes,
+                self.indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(self.indices), self.batch_size, n_threads)
+
+    def __iter__(self):
+        if self._h is None:  # fallback: synchronous gather
+            for b in range(self.num_batches):
+                sl = self.indices[b * self.batch_size:(b + 1) *
+                                  self.batch_size]
+                yield [a[sl] for a in self.arrays]
+            return
+        n = len(self.arrays)
+        out_ptrs = (ctypes.c_void_p * n)()
+        try:
+            while True:
+                b = self._lib.pipeline_next(self._h, out_ptrs)
+                if b < 0:
+                    break
+                views = []
+                for i, a in enumerate(self.arrays):
+                    shape = (self.batch_size,) + a.shape[1:]
+                    buf = (ctypes.c_char * (
+                        self.batch_size * self._row_bytes[i])).from_address(
+                        out_ptrs[i])
+                    v = np.frombuffer(buf, dtype=a.dtype).reshape(shape)
+                    views.append(v.copy() if self.copy else v)
+                yield views
+        finally:
+            self.close()
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pipeline_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def imm_dominators_edges(n: int, edges):
+    """Immediate dominators of an int-id DAG. edges: iterable of (src, dst).
+    Returns an int32 array with -1 for roots, or None when the native library
+    is unavailable. Raises ValueError on cycles."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    esrc = np.ascontiguousarray([e[0] for e in edges], dtype=np.int32)
+    edst = np.ascontiguousarray([e[1] for e in edges], dtype=np.int32)
+    out = np.empty(n, dtype=np.int32)
+    rc = lib.imm_dominators_native(
+        n, len(esrc),
+        esrc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        edst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc == -2:
+        raise ValueError("imm_dominators: graph has a cycle")
+    if rc != 0:
+        raise ValueError("imm_dominators: invalid edge list")
+    return out
 
 
 def _simulate_py(costs, device, n_devices, esrc, edst) -> float:

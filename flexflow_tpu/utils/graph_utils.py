@@ -115,9 +115,30 @@ def _imm_from_sets(g: BasicGraph[N], doms: Dict[N, Set[N]],
     return imm
 
 
+def _imm_dominators_native(g: BasicGraph[N]) -> Optional[Dict[N, N]]:
+    """Native CHK fast path (flexflow_tpu/native/ffnative.cpp::
+    imm_dominators_native); None when the library is unavailable."""
+    try:
+        from ..native import imm_dominators_edges
+    except ImportError:
+        return None
+    nodes = list(g.nodes)
+    ids = {n: i for i, n in enumerate(nodes)}
+    edges = [(ids[u], ids[v]) for u in nodes for v in g.out_edges(u)]
+    out = imm_dominators_edges(len(nodes), edges)
+    if out is None:
+        return None
+    return {n: (n if out[i] < 0 else nodes[out[i]])
+            for i, n in enumerate(nodes)}
+
+
 def imm_dominators(g: BasicGraph[N]) -> Dict[N, N]:
     """node -> its immediate dominator (itself for sources;
     dominators.h:246)."""
+    if len(g.nodes) > 64:  # native pays off on large graphs
+        native = _imm_dominators_native(g)
+        if native is not None:
+            return native
     return _imm_from_sets(g, dominators(g), g.topo_order())
 
 

@@ -114,3 +114,29 @@ def test_torch_mlp():
 
 # inception/resnext example wrappers are exercised at tiny scale by
 # tests/test_model_zoo.py (same builders); full-size runs are bench-only.
+
+
+def test_onnx_mlp_or_skip():
+    mod = _load("onnx", "onnx_mlp")
+    ff, perf = mod.main(SMALL)
+    if ff is None:  # onnx not installed: gated skip is the contract
+        return
+    assert perf.accuracy() >= 0.0
+
+
+def test_module_launcher(tmp_path):
+    """python -m flexflow_tpu script.py -b 16 (flexflow_python analog)."""
+    import subprocess
+
+    script = tmp_path / "tiny.py"
+    script.write_text(
+        "from flexflow_tpu import FFConfig\n"
+        "c = FFConfig()\n"
+        "assert c.batch_size == 16, c.batch_size\n"
+        "print('LAUNCHER_OK', c.batch_size)\n")
+    repo_root = os.path.dirname(os.path.dirname(EXAMPLES))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    r = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", str(script), "-b", "16"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert "LAUNCHER_OK 16" in r.stdout, (r.stdout, r.stderr)
