@@ -1,0 +1,55 @@
+"""HuggingFace model via the torch-fx frontend (reference: the mt5 pipeline
+in examples/python/pytorch/mt5/ and hf_symbolic_trace support in
+python/flexflow/torch/model.py:2427): trace a transformers BertModel, copy its
+weights, and fine-tune a classification head on synthetic data."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None, hf_cfg=None):
+    from transformers import BertConfig, BertModel
+
+    from flexflow_tpu import (AdamOptimizer, DataType, FFConfig, FFModel,
+                              LossType, MetricsType)
+    from flexflow_tpu.frontends.torch_fx import (PyTorchModel,
+                                                 copy_torch_weights)
+
+    hf_cfg = hf_cfg or BertConfig(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, vocab_size=1000, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    module = BertModel(hf_cfg)
+    module.eval()
+
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    ff = FFModel(config)
+    bs, seq = config.batch_size, 16
+    ids_t = ff.create_tensor((bs, seq), dtype=DataType.DT_INT32,
+                             name="input_ids")
+    outputs = PyTorchModel(module, is_hf_model=True).torch_to_ff(
+        ff, [ids_t], input_names=["input_ids"])
+    logits = ff.dense(outputs["pooler_output"], 2, name="cls_head")
+    probs = ff.softmax(logits)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY], final_tensor=probs)
+    copy_torch_weights(ff)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, hf_cfg.vocab_size, size=(bs * 2, seq)
+                       ).astype(np.int32)
+    y = rng.integers(0, 2, size=(bs * 2,)).astype(np.int32)
+    perf = ff.fit(ids, y, epochs=config.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -26,7 +26,9 @@ from .metrics import Metrics
 class Executor:
     def __init__(self, pcg: PCG, mesh, strategy: Strategy, loss_type,
                  metrics: Metrics, optimizer, config, final_guid: int,
-                 label_dtype: DataType, repl_labels: bool = False):
+                 label_dtype: DataType, repl_labels: bool = False,
+                 final_out_idx: int = 0):
+        self.final_out_idx = final_out_idx
         self.pcg = pcg
         self.mesh = mesh
         self.strategy = strategy
@@ -222,7 +224,7 @@ class Executor:
             params_c, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[])
             values = self.forward_outputs(params_c, self._bind_inputs(xs), ctx)
-            logits = self._logits_f32(values[self.final_guid][0])
+            logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
             loss = loss_value(self.loss_type, logits, labels,
                               self.repl_labels)
             for aux in ctx.aux_losses:
@@ -261,7 +263,7 @@ class Executor:
             params, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=False, rng=None, mesh=mesh)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
-            logits = self._logits_f32(values[self.final_guid][0])
+            logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
             loss = loss_value(self.loss_type, logits, labels, self.repl_labels)
             m = self._compute_metrics(logits, labels)
             return loss, m
@@ -281,7 +283,7 @@ class Executor:
             params, xs = self._cast_for_compute(params, xs)
             ctx = OpContext(training=False, rng=None, mesh=mesh)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
-            return values[self.final_guid][0]
+            return values[self.final_guid][self.final_out_idx]
 
         self._forward_jit = jax.jit(fwd)
         return self._forward_jit

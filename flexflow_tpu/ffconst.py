@@ -186,6 +186,12 @@ class OperatorType(enum.IntEnum):
     # recurrent family (reference: nmt/ hand-written lstm.cu predating the
     # FFModel op set; we promote it to a first-class op)
     OP_LSTM = 113
+    # constant (frozen host tensor baked into the graph — needed by the
+    # torch-fx frontend for traced buffers like position_ids)
+    OP_CONSTANT = 114
+    # attention core without projections (torch F.scaled_dot_product_attention;
+    # reference analog: the cuDNN MHA core inside attention.cu)
+    OP_SDPA = 115
 
 
 # --- dtype helpers -------------------------------------------------------------

@@ -25,23 +25,30 @@ class PyTorchModel:
         self.module = module
         self.is_hf_model = is_hf_model
 
-    def torch_to_ff(self, ffmodel: FFModel, input_tensors: List[Tensor]
-                    ) -> List[Tensor]:
-        """Trace the module and emit FFModel ops; returns output tensors
-        (reference: torch_to_ff, model.py:2496)."""
+    def torch_to_ff(self, ffmodel: FFModel, input_tensors: List[Tensor],
+                    input_names: Optional[List[str]] = None):
+        """Trace the module and emit FFModel ops; returns output tensors — a
+        list, or a dict for HF models returning ModelOutput dicts (reference:
+        torch_to_ff, model.py:2496; hf_symbolic_trace support :2427).
+
+        Shape arithmetic and mask plumbing in the traced graph (size/getitem/
+        ones/expand/masked_fill on host values) are evaluated eagerly as
+        numpy; only real tensor compute becomes graph ops. Traced buffers
+        (position_ids) surface as OP_CONSTANT nodes."""
         import torch
         import torch.fx as fx
 
         if self.is_hf_model:
             from transformers.utils.fx import symbolic_trace as hf_trace
 
-            traced = hf_trace(self.module)
+            traced = hf_trace(self.module,
+                              input_names=input_names or ["input_ids"])
         else:
             traced = fx.symbolic_trace(self.module)
 
         env: Dict[str, Any] = {}
         inputs = list(input_tensors)
-        outputs: List[Tensor] = []
+        outputs: Any = []
         modules = dict(traced.named_modules())
 
         for node in traced.graph.nodes:
@@ -56,10 +63,15 @@ class PyTorchModel:
                     ffmodel, node, _args(env, node.args),
                     {k: _lookup(env, v) for k, v in node.kwargs.items()})
             elif node.op == "get_attr":
-                env[node.name] = _fetch_attr(self.module, node.target)
+                attr = _fetch_attr(self.module, node.target)
+                if isinstance(attr, torch.Tensor):
+                    attr = _np(attr)  # buffers stay eager until consumed
+                env[node.name] = attr
             elif node.op == "output":
                 out = node.args[0]
-                if isinstance(out, (tuple, list)):
+                if isinstance(out, dict):
+                    outputs = {k: _lookup(env, v) for k, v in out.items()}
+                elif isinstance(out, (tuple, list)):
                     outputs = [_lookup(env, o) for o in out]
                 else:
                     outputs = [_lookup(env, out)]
@@ -80,6 +92,9 @@ def _lookup(env, a):
         return env[a.name]
     if isinstance(a, (tuple, list)):
         return type(a)(_lookup(env, x) for x in a)
+    if isinstance(a, slice):  # traced shapes appear inside slice bounds
+        return slice(_lookup(env, a.start), _lookup(env, a.stop),
+                     _lookup(env, a.step))
     return a
 
 
@@ -120,11 +135,44 @@ def copy_torch_weights(ffmodel: FFModel) -> None:
             ffmodel.params[lname][wname] = jax.device_put(arr, cur.sharding)
 
 
+def _is_ff(v) -> bool:
+    return isinstance(v, Tensor)
+
+
+def _as_ff(ffmodel: FFModel, v, int_ids: bool = False):
+    """Promote an eager numpy/scalar value to a graph constant when it meets
+    real tensor compute."""
+    if _is_ff(v):
+        return v
+    arr = np.asarray(v)
+    if int_ids and arr.dtype != np.int32:
+        # int64 ids would be truncated by jax (x64 disabled) with a warning
+        arr = arr.astype(np.int32)
+    return ffmodel.constant(arr)
+
+
+def _torch_dtype_of(v):
+    """torch dtype of a traced value — lets torch.finfo/torch.tensor(...,
+    dtype=x.dtype) evaluate eagerly."""
+    import torch
+
+    if _is_ff(v):
+        from ..ffconst import dtype_to_jnp
+
+        return getattr(torch, np.dtype(str(dtype_to_jnp(v.dtype))).name,
+                       torch.float32)
+    return getattr(torch, str(np.asarray(v).dtype), torch.float32)
+
+
 def _convert_module(ffmodel: FFModel, mod, args, name: str):
     import torch.nn as nn
 
     name = name.replace(".", "_")
     x = args[0]
+    if isinstance(mod, nn.Embedding) and not _is_ff(x):
+        x = _as_ff(ffmodel, x, int_ids=True)  # traced buffer ids
+    if not _is_ff(x):
+        x = _as_ff(ffmodel, x)
     if isinstance(mod, nn.Linear):
         out = ffmodel.dense(x, mod.out_features, use_bias=mod.bias is not None,
                             name=name)
@@ -208,12 +256,47 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
     t = node.target
     if node.op == "call_method":
         x = args[0]
+        # ---- shape/meta queries: always eager (static shapes) -------------
+        if t == "size":
+            dims = tuple(x.dims) if _is_ff(x) else np.asarray(x).shape
+            return dims[args[1]] if len(args) > 1 else dims
+        if t == "dim":
+            return len(x.dims) if _is_ff(x) else np.asarray(x).ndim
+        # ---- eager numpy receivers (mask plumbing, traced buffers) --------
+        if not _is_ff(x):
+            x = np.asarray(x)
+            if t == "expand":
+                sizes = list(args[1:])
+                off = len(sizes) - x.ndim  # torch aligns sizes to trailing dims
+                shape = [x.shape[i - off] if a == -1 else int(a)
+                         for i, a in enumerate(sizes)]
+                return np.broadcast_to(x, shape)
+            if t == "to":
+                target = args[1] if len(args) > 1 else kwargs.get("dtype")
+                try:
+                    return x.astype(_np_dtype(target))
+                except (TypeError, ValueError):
+                    return x  # .to(device) and friends
+            if t == "masked_fill":
+                mask = np.asarray(args[1])
+                return np.where(mask, args[2], x)
+            if t in ("view", "reshape"):
+                return x.reshape([int(a) for a in args[1:]])
+            if t == "transpose":
+                perm = list(range(x.ndim))
+                i, j = args[1], args[2]
+                perm[i], perm[j] = perm[j], perm[i]
+                return np.transpose(x, perm)
+            if t in ("contiguous", "clone", "detach", "float"):
+                return x
+            raise NotImplementedError(f"torch method {t} on host value")
+        # ---- graph ops on Tensors -----------------------------------------
         if t == "view" or t == "reshape":
             shape = [a for a in args[1:]]
             if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
                 shape = list(shape[0])
-            return ffmodel.reshape(x, [s if isinstance(s, int) else -1
-                                       for s in shape])
+            return ffmodel.reshape(x, [int(s) if isinstance(
+                s, (int, np.integer)) else -1 for s in shape])
         if t == "permute":
             return ffmodel.transpose(x, list(args[1:]))
         if t == "transpose":
@@ -226,11 +309,66 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         if t == "mean":
             return ffmodel.mean(x, dims=[args[1]] if len(args) > 1 else [-1],
                                 keepdims=kwargs.get("keepdim", False))
+        if t == "to":
+            target = args[1] if len(args) > 1 else kwargs.get("dtype")
+            from ..ffconst import jnp_to_dtype
+
+            try:
+                return ffmodel.cast(x, jnp_to_dtype(_np_dtype(target)))
+            except (TypeError, ValueError):
+                return x
         if t == "contiguous" or t == "clone" or t == "detach":
             return x
-        if t == "size" or t == "dim":
-            raise NotImplementedError("dynamic size() in traced graph")
         raise NotImplementedError(f"torch method {t}")
+
+    # ---- eager host-side builtins (shape arithmetic / mask construction) ---
+    if t is getattr:
+        obj, attr = args[0], args[1]
+        if attr == "shape":
+            return tuple(obj.dims) if _is_ff(obj) else np.asarray(obj).shape
+        if attr == "dtype":
+            return _torch_dtype_of(obj)
+        if attr == "device":
+            return torch.device("cpu")
+        return getattr(obj, attr)  # finfo.min etc. — eager objects
+    if t is operator.getitem:
+        obj = args[0]
+        if _is_ff(obj):
+            items = args[1] if isinstance(args[1], tuple) else (args[1],)
+            return ffmodel.slice_tensor(obj, items)
+        return obj[args[1]]
+    if t is torch.ones:
+        shape = args[0] if isinstance(args[0], (tuple, list)) else args
+        return np.ones([int(s) for s in shape], dtype=np.float32)
+    if t is torch.zeros:
+        shape = args[0] if isinstance(args[0], (tuple, list)) else args
+        return np.zeros([int(s) for s in shape], dtype=np.float32)
+    if t is torch.tensor:
+        return np.asarray(args[0],
+                          dtype=_np_dtype(kwargs.get("dtype")) if
+                          kwargs.get("dtype") is not None else None)
+    if t is torch.finfo:
+        return torch.finfo(args[0])
+    if t is operator.eq:
+        if not _is_ff(args[0]) and not _is_ff(args[1]):
+            return args[0] == args[1]
+    if t is torch.nn.functional.scaled_dot_product_attention or \
+            (getattr(t, "__name__", "") == "scaled_dot_product_attention"):
+        q, k, v = args[0], args[1], args[2]
+        mask = kwargs.get("attn_mask", args[3] if len(args) > 3 else None)
+        if mask is not None and not _is_ff(mask):
+            mask = np.asarray(mask)
+            if mask.dtype == bool:
+                # torch bool semantics: True = attend, False = -inf
+                mask = None if mask.all() else _as_ff(ffmodel, mask)
+            else:
+                mask = mask.astype(np.float32)
+                # all-zero additive mask: no-op
+                mask = None if not mask.any() else _as_ff(ffmodel, mask)
+        return ffmodel.sdpa(q, k, v, attn_mask=mask,
+                            dropout=kwargs.get("dropout_p", 0.0),
+                            causal=kwargs.get("is_causal", False),
+                            scale=kwargs.get("scale"))
 
     if t in (operator.add, torch.add):
         return _binary(ffmodel, "add", args)
@@ -240,6 +378,8 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return _binary(ffmodel, "multiply", args)
     if t in (operator.truediv, torch.div):
         return _binary(ffmodel, "divide", args)
+    if getattr(t, "__name__", "") == "gelu":  # torch._C._nn.gelu builtin
+        return ffmodel.gelu(args[0])
     if t in (torch.matmul, torch.bmm):
         return ffmodel.batch_matmul(args[0], args[1])
     if t is F.relu or t is torch.relu:
@@ -273,11 +413,37 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
     raise NotImplementedError(f"torch function {t}")
 
 
+def _np_dtype(torch_dtype):
+    """torch dtype object -> numpy dtype (eager mask/buffer arithmetic)."""
+    import torch
+
+    if torch_dtype is None:
+        return np.float32
+    if isinstance(torch_dtype, np.dtype) or isinstance(torch_dtype, type):
+        return np.dtype(torch_dtype)
+    if torch_dtype is torch.bool:
+        return np.dtype(bool)
+    return np.dtype(str(torch_dtype).replace("torch.", ""))
+
+
 def _binary(ffmodel: FFModel, opname: str, args):
     a, b = args[0], args[1]
-    if isinstance(b, (int, float)):
+    if not _is_ff(a) and not _is_ff(b):
+        # both host values (shape arithmetic / mask construction): eager
+        fn = {"add": np.add, "subtract": np.subtract,
+              "multiply": np.multiply, "divide": np.true_divide}[opname]
+        r = fn(a, b)
+        if np.ndim(r) == 0 and not isinstance(a, np.ndarray) \
+                and not isinstance(b, np.ndarray):
+            return r.item()
+        return r
+    if _is_ff(a) and isinstance(b, (int, float)):
         scalar_map = {"add": "scalar_add", "subtract": "scalar_sub",
                       "multiply": "scalar_multiply",
                       "divide": "scalar_true_divide"}
         return getattr(ffmodel, scalar_map[opname])(a, float(b))
-    return getattr(ffmodel, opname)(a, b)
+    if _is_ff(b) and isinstance(a, (int, float)) and opname in ("add",
+                                                                "multiply"):
+        scalar_map = {"add": "scalar_add", "multiply": "scalar_multiply"}
+        return getattr(ffmodel, scalar_map[opname])(b, float(a))
+    return getattr(ffmodel, opname)(_as_ff(ffmodel, a), _as_ff(ffmodel, b))

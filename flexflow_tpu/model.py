@@ -279,6 +279,37 @@ class FFModel:
     def reverse(self, x, axis: int, name=None):
         return self._unary(OperatorType.OP_REVERSE, x, {"axis": axis}, name)
 
+    def slice_tensor(self, x, items, name=None):
+        """Static getitem: items is a tuple of slice/int/None (torch frontend
+        getitem; reference OP_SLICE)."""
+        from .ops.tensor_ops import encode_slice_items
+
+        return self._unary(OperatorType.OP_SLICE, x,
+                           {"items": encode_slice_items(items)}, name)
+
+    def constant(self, value, dtype: Optional[DataType] = None, name=None):
+        """Frozen host tensor as a graph node (traced buffers like
+        position_ids; reference analog: non-trainable weight tensors)."""
+        import numpy as np
+
+        from .ffconst import jnp_to_dtype
+
+        value = np.asarray(value)
+        if dtype is None:
+            dtype = jnp_to_dtype(value.dtype)
+        return self._add_layer(OperatorType.OP_CONSTANT, [],
+                               {"value": value}, dtype, name)
+
+    def sdpa(self, q: Tensor, k: Tensor, v: Tensor,
+             attn_mask: Optional[Tensor] = None, dropout: float = 0.0,
+             causal: bool = False, scale: Optional[float] = None, name=None):
+        """Attention core on pre-projected (batch, heads, seq, head_dim)
+        tensors (torch F.scaled_dot_product_attention)."""
+        inputs = [q, k, v] + ([attn_mask] if attn_mask is not None else [])
+        return self._add_layer(OperatorType.OP_SDPA, inputs,
+                               {"dropout": dropout, "causal": causal,
+                                "scale": scale}, q.dtype, name)
+
     def lstm(self, input: Tensor, hidden_size: int,
              initial_state: Optional[Tensor] = None,
              name: Optional[str] = None) -> List[Tensor]:
@@ -382,9 +413,14 @@ class FFModel:
                 loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                 metrics: Optional[List[MetricsType]] = None,
                 comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
-                strategy=None, strategy_fn=None) -> None:
+                strategy=None, strategy_fn=None,
+                final_tensor: Optional[Tensor] = None) -> None:
         """Lower the Layer graph to a PCG, pick a strategy, build the executor
-        (reference pipeline: src/runtime/model.cc:2803, SURVEY §3.3)."""
+        (reference pipeline: src/runtime/model.cc:2803, SURVEY §3.3).
+
+        final_tensor: anchor the loss/outputs to this tensor instead of the
+        graph sink (needed for multi-output frontends, e.g. HF ModelOutput
+        dicts where last_hidden_state is not a sink)."""
         from .execution.executor import Executor
         from .parallel.mesh import build_mesh
         from .parallel.pcg import PCG
@@ -402,9 +438,14 @@ class FFModel:
         pcg = self.create_pcg()
 
         # final op = last compute node (the reference uses the graph's sink)
-        sinks = [n for n in pcg.sinks()
-                 if n.op.op_type != OperatorType.OP_INPUT]
-        final = sinks[-1]
+        if final_tensor is not None:
+            final = pcg.nodes[self._tensor_to_node[final_tensor.guid]]
+            self.final_out_idx = final_tensor.owner_idx or 0
+        else:
+            sinks = [n for n in pcg.sinks()
+                     if n.op.op_type != OperatorType.OP_INPUT]
+            final = sinks[-1]
+            self.final_out_idx = 0
         self.final_guid = final.guid
         repl_labels = final.op.op_type == OperatorType.OP_AGG_SPEC
 
@@ -461,27 +502,32 @@ class FFModel:
 
             pcg, n_fused = apply_fusion(pcg, self.strategy)
             if n_fused:
-                sinks = [n for n in pcg.sinks()
-                         if n.op.op_type != OperatorType.OP_INPUT]
-                final = sinks[-1]
-                self.final_guid = final.guid
+                if final_tensor is not None and self.final_guid in pcg.nodes:
+                    final = pcg.nodes[self.final_guid]  # anchor survived
+                else:
+                    sinks = [n for n in pcg.sinks()
+                             if n.op.op_type != OperatorType.OP_INPUT]
+                    final = sinks[-1]
+                    self.final_guid = final.guid
+                    self.final_out_idx = 0
                 repl_labels = final.op.op_type == OperatorType.OP_AGG_SPEC
 
         # -- label tensor (model.cc:3090-3124) ----------------------------------
-        out_shape = final.out_shapes[0]
+        out_shape = final.out_shapes[self.final_out_idx]
         if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
             label_shape = (out_shape[0], 1)
             label_dtype = DataType.DT_INT32
         else:
             label_shape = out_shape
-            label_dtype = final.out_dtypes[0]
+            label_dtype = final.out_dtypes[self.final_out_idx]
         self.label_tensor = Tensor(label_shape, label_dtype, name="label",
                                    model=self)
 
         self.pcg = pcg
         self.executor = Executor(pcg, self.mesh, self.strategy, loss_type,
                                  self.metrics_obj, self.optimizer, self.config,
-                                 self.final_guid, label_dtype, repl_labels)
+                                 self.final_guid, label_dtype, repl_labels,
+                                 final_out_idx=self.final_out_idx)
         self.params = self.executor.init_params(self.config.numpy_seed())
         self.opt_state = self.optimizer.init_state(self.params)
 
@@ -647,7 +693,7 @@ class FFModel:
             fwdvals = self.executor.forward_outputs(
                 params, self.executor._bind_inputs(xs),
                 OpContext(training=True, rng=self._next_rng(), mesh=self.mesh))
-            logits = fwdvals[self.final_guid][0]
+            logits = fwdvals[self.final_guid][self.executor.final_out_idx]
             return loss_value(self.loss_type, logits, y,
                               self.executor.repl_labels)
 

@@ -24,15 +24,23 @@ from .base import Op, OpContext, register_op
 
 
 def mha_core(q, k, v, *, causal: bool = False, dropout: float = 0.0,
-             rng=None, training: bool = False):
-    """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim)."""
+             rng=None, training: bool = False, attn_mask=None,
+             scale: float = None):
+    """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim).
+    attn_mask: optional additive mask broadcastable to (b, h, seq_q, seq_k)."""
     import jax
     import jax.numpy as jnp
 
     head_dim = q.shape[-1]
-    scale = 1.0 / np.sqrt(head_dim)
+    scale = scale if scale is not None else 1.0 / np.sqrt(head_dim)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if attn_mask is not None:
+        if jnp.issubdtype(attn_mask.dtype, jnp.bool_):
+            # torch bool-mask semantics: True = attend, False = -inf
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
@@ -150,3 +158,45 @@ def _should_use_flash(use_flash, q, k, causal) -> bool:
         return on_tpu and q.shape[-2] >= 1024 and q.shape[-2] % 128 == 0 \
             and k.shape[-2] % 128 == 0 and q.shape[-1] % 128 == 0
     return False
+
+
+@register_op(OperatorType.OP_SDPA)
+class SDPAOp(Op):
+    """Scaled-dot-product attention core without projections (torch
+    F.scaled_dot_product_attention; reference analog: the cuDNN core inside
+    src/ops/attention.cu minus the packed q/k/v/o projections).
+
+    inputs: (q, k, v[, additive attn_mask]), q/k/v (batch, heads, seq, hd).
+    attrs: dropout, causal, scale (None = 1/sqrt(head_dim)), use_flash.
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        q, _k, v = input_shapes[:3]
+        return [tuple(q[:-1]) + (v[-1],)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        q, k, v = inputs[:3]
+        mask = inputs[3] if len(inputs) > 3 else None
+        causal = self.attrs.get("causal", False)
+        # flash kernel has no mask/scale/dropout parameters — only take it
+        # when the request needs none of them
+        if mask is None and self.attrs.get("scale") is None \
+                and self.attrs.get("dropout", 0.0) == 0.0 \
+                and _should_use_flash(
+                    self.attrs.get("use_flash", "auto"), q, k, causal):
+            from ..kernels.flash_attention import flash_attention
+
+            return [flash_attention(q, k, v, causal)]
+        return [mha_core(q, k, v, causal=causal,
+                         dropout=self.attrs.get("dropout", 0.0),
+                         rng=ctx.rng, training=ctx.training,
+                         attn_mask=mask, scale=self.attrs.get("scale"))]
+
+    def flops(self, input_shapes, output_shapes):
+        b, h, sq, d = input_shapes[0]
+        sk = input_shapes[1][2]
+        vd = input_shapes[2][3]
+        return 2 * b * h * sq * sk * (d + vd)
+
+    def parallelizable_dims(self, input_shapes):
+        return {"batch": True}

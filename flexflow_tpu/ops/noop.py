@@ -38,3 +38,24 @@ class WeightOp(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         raise RuntimeError("WeightOp is bound by the executor, never executed")
+
+
+@register_op(OperatorType.OP_CONSTANT)
+class ConstantOp(Op):
+    """Frozen host tensor baked into the graph (attrs: value — np.ndarray).
+    Needed by the torch-fx frontend for traced module buffers (position_ids,
+    token_type_ids, attention masks); the reference keeps such buffers as
+    non-trainable weight tensors."""
+
+    def infer_output_shapes(self, input_shapes):
+        import numpy as np
+
+        return [tuple(np.asarray(self.attrs["value"]).shape)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        from ..ffconst import dtype_to_jnp
+
+        return [jnp.asarray(self.attrs["value"],
+                            dtype=dtype_to_jnp(self.data_type))]

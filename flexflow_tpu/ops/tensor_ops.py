@@ -201,3 +201,57 @@ class BatchMatmulOp(Op):
         a = input_shapes[0]
         n = output_shapes[0][-1]
         return 2 * int(np.prod(a)) * n
+
+
+@register_op(OperatorType.OP_SLICE)
+class SliceOp(Op):
+    """Static tensor slicing / indexing (reference: OP_SLICE, ffconst.h; the
+    torch frontend's getitem). attrs: items — a tuple where each element is
+    ("slice", start, stop, step) with None encoded as "none", ("index", i),
+    or ("newaxis",)."""
+
+    def _indexer(self):
+        def dec(v):
+            return None if v == "none" else v
+
+        idx = []
+        for it in self.attrs["items"]:
+            if it[0] == "slice":
+                idx.append(slice(dec(it[1]), dec(it[2]), dec(it[3])))
+            elif it[0] == "index":
+                idx.append(int(it[1]))
+            elif it[0] == "newaxis":
+                idx.append(None)
+            else:
+                raise ValueError(f"bad slice item {it}")
+        return tuple(idx)
+
+    def infer_output_shapes(self, input_shapes):
+        # zero-stride view: shape inference without allocating the input
+        ref = np.broadcast_to(np.int8(0), input_shapes[0])
+        return [tuple(ref[self._indexer()].shape)]
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0][self._indexer()]]
+
+    def can_inplace_output(self):
+        return False
+
+
+def encode_slice_items(items) -> Tuple:
+    """Python (slice | int | None) tuple -> hashable SliceOp attrs encoding."""
+    enc = []
+    for it in items:
+        if isinstance(it, slice):
+            n = "none"
+            enc.append(("slice",
+                        n if it.start is None else int(it.start),
+                        n if it.stop is None else int(it.stop),
+                        n if it.step is None else int(it.step)))
+        elif it is None:
+            enc.append(("newaxis",))
+        elif isinstance(it, (int, np.integer)):
+            enc.append(("index", int(it)))
+        else:
+            raise NotImplementedError(f"slice item {it!r}")
+    return tuple(enc)

@@ -140,3 +140,9 @@ def test_module_launcher(tmp_path):
         [sys.executable, "-m", "flexflow_tpu", str(script), "-b", "16"],
         capture_output=True, text=True, env=env, timeout=120)
     assert "LAUNCHER_OK 16" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_hf_bert_example():
+    pytest.importorskip("transformers")
+    _, perf = _load("pytorch", "hf_bert").main(SMALL)
+    assert perf.train_all == 16

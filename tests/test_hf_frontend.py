@@ -1,0 +1,135 @@
+"""HuggingFace-model tracing tier (reference: hf_symbolic_trace support in
+python/flexflow/torch/model.py:2427-2494 and the mt5 alignment test in
+tests/align). Traces a tiny HF BERT encoder through the torch-fx frontend and
+aligns the forward numerics against transformers' own output."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType  # noqa: E402
+from flexflow_tpu.frontends.torch_fx import (PyTorchModel,  # noqa: E402
+                                             copy_torch_weights)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     vocab_size=100, max_position_embeddings=16,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    m = BertModel(cfg)
+    m.eval()
+    return m, cfg
+
+
+def test_hf_bert_traces_and_aligns(tiny_bert):
+    module, hf_cfg = tiny_bert
+    batch, seq = 2, 8
+
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    ids_t = ff.create_tensor((batch, seq), dtype=DataType.DT_INT32,
+                             name="input_ids")
+    outputs = PyTorchModel(module, is_hf_model=True).torch_to_ff(
+        ff, [ids_t], input_names=["input_ids"])
+    assert isinstance(outputs, dict) and "last_hidden_state" in outputs, \
+        outputs
+    last = outputs["last_hidden_state"]
+    assert tuple(last.dims) == (batch, seq, hf_cfg.hidden_size)
+
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=last)
+    copy_torch_weights(ff)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, hf_cfg.vocab_size, size=(batch, seq)
+                       ).astype(np.int32)
+    with torch.no_grad():
+        ref = module(torch.from_numpy(ids.astype(np.int64))
+                     ).last_hidden_state.numpy()
+    got = np.asarray(ff.executor.make_forward()(ff.params, [ids]))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_bert_pooler_output_aligns(tiny_bert):
+    module, hf_cfg = tiny_bert
+    batch, seq = 2, 8
+
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    ids_t = ff.create_tensor((batch, seq), dtype=DataType.DT_INT32)
+    outputs = PyTorchModel(module, is_hf_model=True).torch_to_ff(
+        ff, [ids_t], input_names=["input_ids"])
+    pooled = outputs["pooler_output"]
+    assert tuple(pooled.dims) == (batch, hf_cfg.hidden_size)
+
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=pooled)
+    copy_torch_weights(ff)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, hf_cfg.vocab_size, size=(batch, seq)
+                       ).astype(np.int32)
+    with torch.no_grad():
+        ref = module(torch.from_numpy(ids.astype(np.int64))
+                     ).pooler_output.numpy()
+    got = np.asarray(ff.executor.make_forward()(ff.params, [ids]))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_sdpa_bool_mask_matches_torch():
+    """torch bool-mask semantics (True = attend) through FFModel.sdpa."""
+    import torch.nn.functional as F
+
+    from flexflow_tpu import FFConfig, FFModel
+
+    b, h, s, d = 2, 2, 4, 8
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    k = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    v = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    mask = rng.random(size=(b, 1, s, s)) > 0.3
+    mask[..., 0] = True  # every query attends at least one key
+
+    config = FFConfig()
+    config.batch_size = b
+    ff = FFModel(config)
+    qt = ff.create_tensor((b, h, s, d))
+    kt = ff.create_tensor((b, h, s, d))
+    vt = ff.create_tensor((b, h, s, d))
+    mt = ff.constant(mask)
+    out = ff.sdpa(qt, kt, vt, attn_mask=mt)
+    from flexflow_tpu import LossType
+
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=out)
+    got = np.asarray(ff.executor.make_forward()(
+        ff.params, [q, k, v]))
+    ref = F.scaled_dot_product_attention(
+        torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+        attn_mask=torch.from_numpy(mask)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_final_tensor_multi_output_index():
+    """compile(final_tensor=) must anchor to the requested OUTPUT, not just
+    the node (multi-output ops like split)."""
+    from flexflow_tpu import FFConfig, FFModel, LossType
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 8))
+    parts = ff.split(x, 2, axis=1)  # two (4, 4) outputs
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=parts[1])
+    xs = np.arange(32, dtype=np.float32).reshape(4, 8)
+    got = np.asarray(ff.executor.make_forward()(ff.params, [xs]))
+    np.testing.assert_array_equal(got, xs[:, 4:])
