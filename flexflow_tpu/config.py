@@ -15,6 +15,19 @@ from typing import List, Optional, Sequence
 from .ffconst import CompMode, DataType
 
 
+def _pin_platform_from_env(jax) -> None:
+    """Honor JAX_PLATFORMS even when a site hook registered an accelerator
+    plugin: the env var alone doesn't stop the hook from dialing the device
+    client on the first backend query (which hangs if the device tunnel is
+    down); the config update does."""
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        try:
+            jax.config.update("jax_platforms", plats)
+        except Exception:
+            pass
+
+
 @dataclasses.dataclass
 class FFIterationConfig:
     """Per-iteration knobs (reference: config.h:164-169)."""
@@ -105,6 +118,7 @@ class FFConfig:
             try:
                 import jax
 
+                _pin_platform_from_env(jax)
                 self.workers_per_node = max(1, len(jax.devices()) // self.num_nodes)
             except Exception:
                 self.workers_per_node = 1

@@ -317,6 +317,10 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
                 return ffmodel.cast(x, jnp_to_dtype(_np_dtype(target)))
             except (TypeError, ValueError):
                 return x
+        if t == "float":
+            from ..ffconst import DataType
+
+            return ffmodel.cast(x, DataType.DT_FLOAT)
         if t == "contiguous" or t == "clone" or t == "detach":
             return x
         raise NotImplementedError(f"torch method {t}")
@@ -354,8 +358,14 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
             return args[0] == args[1]
     if t is torch.nn.functional.scaled_dot_product_attention or \
             (getattr(t, "__name__", "") == "scaled_dot_product_attention"):
+        # torch signature: (query, key, value, attn_mask=None, dropout_p=0.0,
+        # is_causal=False, *, scale=None) — args may arrive positionally
         q, k, v = args[0], args[1], args[2]
         mask = kwargs.get("attn_mask", args[3] if len(args) > 3 else None)
+        dropout_p = kwargs.get("dropout_p",
+                               args[4] if len(args) > 4 else 0.0)
+        is_causal = kwargs.get("is_causal",
+                               args[5] if len(args) > 5 else False)
         if mask is not None and not _is_ff(mask):
             mask = np.asarray(mask)
             if mask.dtype == bool:
@@ -365,10 +375,8 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
                 mask = mask.astype(np.float32)
                 # all-zero additive mask: no-op
                 mask = None if not mask.any() else _as_ff(ffmodel, mask)
-        return ffmodel.sdpa(q, k, v, attn_mask=mask,
-                            dropout=kwargs.get("dropout_p", 0.0),
-                            causal=kwargs.get("is_causal", False),
-                            scale=kwargs.get("scale"))
+        return ffmodel.sdpa(q, k, v, attn_mask=mask, dropout=dropout_p,
+                            causal=is_causal, scale=kwargs.get("scale"))
 
     if t in (operator.add, torch.add):
         return _binary(ffmodel, "add", args)

@@ -500,10 +500,17 @@ class FFModel:
         if self.config.perform_fusion:
             from .ops.fused import apply_fusion
 
-            pcg, n_fused = apply_fusion(pcg, self.strategy)
+            pcg, n_fused, fusion_remap = apply_fusion(
+                pcg, self.strategy, barrier_guids=(self.final_guid,))
             if n_fused:
-                if final_tensor is not None and self.final_guid in pcg.nodes:
-                    final = pcg.nodes[self.final_guid]  # anchor survived
+                if final_tensor is not None:
+                    # the barrier guarantees the anchor is unfused or a
+                    # region tail; follow the remap either way
+                    new_guid, new_idx = fusion_remap[self.final_guid]
+                    self.final_guid = new_guid
+                    if new_idx >= 0:
+                        self.final_out_idx = new_idx
+                    final = pcg.nodes[self.final_guid]
                 else:
                     sinks = [n for n in pcg.sinks()
                              if n.op.op_type != OperatorType.OP_INPUT]

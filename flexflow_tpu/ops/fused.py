@@ -147,17 +147,23 @@ def _eligible(node, strategy) -> bool:
     return True
 
 
-def apply_fusion(pcg, strategy=None, max_region: int = 16):
+def apply_fusion(pcg, strategy=None, max_region: int = 16,
+                 barrier_guids=()):
     """Merge single-consumer chains of same-view ops into FusedOp nodes.
 
-    Returns (new_pcg, n_fused_regions). ``strategy`` (if given) is updated
-    in place: chain members' entries are dropped (they had none of interest
-    — _eligible guarantees it).
+    Returns (new_pcg, n_fused_regions, remap) where remap maps old guid ->
+    (new guid, out idx) — out idx -1 meaning "original indices preserved".
+    ``strategy`` (if given) is updated in place: chain members' entries are
+    dropped (they had none of interest — _eligible guarantees it).
+    ``barrier_guids``: nodes whose outputs must stay addressable (e.g. the
+    compile final anchor) — a chain never extends past them, so they end up
+    either unfused or as a region tail (whose output is the FusedOp's).
 
     Reference: FFModel::apply_fusion loop (model.cc:2965-3040).
     """
     from ..parallel.pcg import PCG, PCGNode, _node_guid
 
+    barriers = set(barrier_guids)
     consumers: Dict[int, List[int]] = {}
     for n in pcg.topo_order():
         for g, _ in n.inputs:
@@ -171,7 +177,7 @@ def apply_fusion(pcg, strategy=None, max_region: int = 16):
             continue
         chain = [node.guid]
         cur = node
-        while len(chain) < max_region:
+        while len(chain) < max_region and cur.guid not in barriers:
             cons = consumers.get(cur.guid, [])
             if len(cons) != 1:
                 break
@@ -190,7 +196,7 @@ def apply_fusion(pcg, strategy=None, max_region: int = 16):
                 in_chain[g] = cid
 
     if not chains:
-        return pcg, 0
+        return pcg, 0, {g: (g, -1) for g in pcg.nodes}
 
     # rebuild the graph, replacing each chain with one FusedOp node
     new = PCG()
@@ -253,4 +259,4 @@ def apply_fusion(pcg, strategy=None, max_region: int = 16):
         if strategy is not None:
             for g in chain:
                 strategy.node_strategies.pop(g, None)
-    return new, len(chains)
+    return new, len(chains), remap

@@ -1,0 +1,7 @@
+"""bert — searched vs data-parallel (reference: scripts/osdi22ae/bert.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["bert"] + sys.argv[1:])

@@ -1,0 +1,7 @@
+"""candle_uno — searched vs data-parallel (reference: scripts/osdi22ae/candle_uno.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["candle_uno"] + sys.argv[1:])

@@ -1,0 +1,7 @@
+"""dlrm — searched vs data-parallel (reference: scripts/osdi22ae/dlrm.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["dlrm"] + sys.argv[1:])

@@ -1,0 +1,7 @@
+"""inception — searched vs data-parallel (reference: scripts/osdi22ae/inception.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["inception"] + sys.argv[1:])

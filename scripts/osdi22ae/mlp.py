@@ -1,0 +1,7 @@
+"""mlp — searched vs data-parallel (reference: scripts/osdi22ae/mlp.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["mlp"] + sys.argv[1:])

@@ -1,0 +1,7 @@
+"""resnext-50 — searched vs data-parallel (reference: scripts/osdi22ae/resnext-50.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["resnext-50"] + sys.argv[1:])

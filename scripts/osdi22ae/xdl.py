@@ -1,0 +1,7 @@
+"""xdl — searched vs data-parallel (reference: scripts/osdi22ae/xdl.sh)."""
+import sys
+
+from run import main
+
+if __name__ == "__main__":
+    main(["xdl"] + sys.argv[1:])

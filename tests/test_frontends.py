@@ -126,3 +126,22 @@ def test_onnx_gated():
 
         with pytest.raises(ImportError, match="onnx package is required"):
             ONNXModel("nonexistent.onnx")
+
+
+def test_keras_exp_gated_on_tensorflow():
+    """keras_exp requires tensorflow (reference: python/flexflow/keras_exp/);
+    the gate is the contract in this tf-free image."""
+    try:
+        import tensorflow  # noqa: F401
+
+        have_tf = True
+    except ImportError:
+        have_tf = False
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel, _require_tf
+
+    if not have_tf:
+        with pytest.raises(ImportError, match="tensorflow package is "
+                                              "required"):
+            _require_tf()
+        with pytest.raises(ImportError):
+            KerasExpModel(None)

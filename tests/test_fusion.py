@@ -102,3 +102,30 @@ def test_fusion_stops_at_multi_consumer():
     assert any(n.name.startswith("a") and
                n.op.op_type == OperatorType.OP_LINEAR
                for n in ff.pcg.compute_nodes()), names
+
+
+def test_fusion_preserves_final_tensor_anchor():
+    """compile(final_tensor=...) with --fusion must keep the anchored tensor
+    addressable: the anchor acts as a fusion barrier (region tail at most)."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+
+    config = FFConfig()
+    config.batch_size = 4
+    config.perform_fusion = True
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 8))
+    t = ff.relu(x)
+    anchor = ff.gelu(t)          # fusable chain relu->gelu
+    ff.dense(anchor, 3)          # later sink that must NOT steal the anchor
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=anchor)
+    xs = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    out = np.asarray(ff.executor.make_forward()(ff.params, [xs]))
+    assert out.shape == (4, 8), out.shape
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    ref = np.asarray(jnn.gelu(jnn.relu(jnp.asarray(xs))))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,23 @@
+"""OSDI'22 artifact protocol smoke (reference: scripts/osdi22ae/*.sh — the
+searched-vs-data-parallel comparison that is the reproducible baseline,
+BASELINE.md)."""
+import os
+import sys
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "osdi22ae")
+
+
+def test_protocol_runs_both_modes():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import run as osdi_run
+
+        dp, searched = osdi_run.main(["mlp", "-b", "16", "--budget", "3",
+                                      "--epochs", "1"])
+    finally:
+        sys.path.remove(SCRIPTS)
+    assert dp["mode"] == "data_parallel" and dp["samples_per_sec"] > 0
+    assert searched["mode"] == "unity_searched" \
+        and searched["samples_per_sec"] > 0
+    assert dp["mesh"] == {"data": 8}
