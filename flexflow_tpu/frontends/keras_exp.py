@@ -70,7 +70,9 @@ class KerasExpModel:
         if isinstance(layer, keras.layers.Dense):
             name = getattr(layer.activation, "__name__", None)
             if name == "softmax":
-                return ff.softmax(ff.dense(x, layer.units, name=layer.name))
+                return ff.softmax(ff.dense(x, layer.units,
+                                           use_bias=layer.use_bias,
+                                           name=layer.name))
             return ff.dense(x, layer.units, acti.get(name,
                                                      ActiMode.AC_MODE_NONE),
                             use_bias=layer.use_bias, name=layer.name)
@@ -83,12 +85,15 @@ class KerasExpModel:
             return ff.conv2d(x, layer.filters, kh, kw, sh, sw, ph, pw,
                              acti.get(name, ActiMode.AC_MODE_NONE),
                              use_bias=layer.use_bias, name=layer.name)
-        if isinstance(layer, keras.layers.MaxPooling2D):
-            return ff.pool2d(x, *layer.pool_size, *layer.strides, 0, 0,
-                             PoolType.POOL_MAX, name=layer.name)
-        if isinstance(layer, keras.layers.AveragePooling2D):
-            return ff.pool2d(x, *layer.pool_size, *layer.strides, 0, 0,
-                             PoolType.POOL_AVG, name=layer.name)
+        if isinstance(layer, (keras.layers.MaxPooling2D,
+                              keras.layers.AveragePooling2D)):
+            ph = layer.pool_size[0] // 2 if layer.padding == "same" else 0
+            pw = layer.pool_size[1] // 2 if layer.padding == "same" else 0
+            pt = (PoolType.POOL_MAX
+                  if isinstance(layer, keras.layers.MaxPooling2D)
+                  else PoolType.POOL_AVG)
+            return ff.pool2d(x, *layer.pool_size, *layer.strides, ph, pw,
+                             pt, name=layer.name)
         if isinstance(layer, keras.layers.Flatten):
             return ff.flat(x, name=layer.name)
         if isinstance(layer, keras.layers.BatchNormalization):

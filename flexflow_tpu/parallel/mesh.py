@@ -51,3 +51,73 @@ def build_mesh(config=None, mesh_shape: Optional[Sequence[int]] = None,
 
 def mesh_axis_size(mesh, axis: str) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join the jax distributed runtime for multi-host SPMD (the reference's
+    control replication + GASNet/UCX inter-node transport, CMakeLists.txt:
+    47-52 — here one call: XLA then runs collectives over ICI within a slice
+    and DCN across hosts automatically).
+
+    On TPU pods the arguments are auto-detected from the environment; on
+    other platforms pass them explicitly. Returns the process index.
+    Idempotent: calling again after successful init is a no-op; a real
+    connection failure (bad coordinator, unreachable hosts) propagates —
+    silently degrading to independent single-host runs would corrupt a
+    multi-host job.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        msg = str(e).lower()
+        benign = ("already initialized" in msg
+                  or "must be called before" in msg)
+        # auto-detected single-host (no explicit coordinator): benign no-op;
+        # an explicit coordinator that fails must propagate — silently
+        # degrading to independent single-host runs would corrupt the job
+        if coordinator_address is not None or not benign:
+            raise
+    except ValueError:
+        if coordinator_address is not None:
+            raise
+    return jax.process_index()
+
+
+def build_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
+                      axis_names: Sequence[str]):
+    """Multi-slice mesh via jax's create_hybrid_device_mesh: ``ici_shape``
+    and ``dcn_shape`` must have EQUAL rank; axis i of the result has size
+    ici_shape[i] * dcn_shape[i], with devices laid out so the DCN factor of
+    an axis never splits an ICI ring. Put the dcn factor on data-parallel
+    axes (e.g. ici (1, 8), dcn (2, 1) for 2 slices x 8 chips = mesh (2, 8))
+    and keep tensor/sequence axes ICI-only."""
+    ici_shape = tuple(ici_shape)
+    dcn_shape = tuple(dcn_shape)
+    if len(ici_shape) != len(dcn_shape):
+        raise ValueError(
+            f"ici_shape {ici_shape} and dcn_shape {dcn_shape} must have "
+            f"equal rank (axis i spans ici*dcn)")
+    if len(tuple(axis_names)) != len(ici_shape):
+        raise ValueError(
+            f"need exactly {len(ici_shape)} axis names, got {axis_names}")
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+    try:
+        dev = mesh_utils.create_hybrid_device_mesh(ici_shape, dcn_shape)
+    except ValueError as e:
+        if "slice_index" not in str(e):
+            raise
+        # virtual CPU devices carry no slice topology: plain row-major
+        # placement (layout only matters on real multi-slice hardware)
+        n = int(np.prod(shape))
+        dev = np.asarray(jax.devices()[:n]).reshape(shape)
+    return Mesh(dev, tuple(axis_names))

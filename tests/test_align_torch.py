@@ -448,3 +448,28 @@ def test_mlp_end_to_end_grad_align(rng):
                                tw2.grad.numpy(), rtol=1e-3, atol=1e-5)
     np.testing.assert_allclose(np.asarray(ffg[fc2_name]["bias"]),
                                tb2.grad.numpy(), rtol=1e-3, atol=1e-5)
+
+
+def test_sdpa_align(rng):
+    """OP_SDPA (F.scaled_dot_product_attention core) fwd+grad, with and
+    without causal masking and custom scale."""
+    b, h, s, d = 2, 2, 6, 8
+    q = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    k = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    v = rng.normal(size=(b, h, s, d)).astype(np.float32)
+
+    for causal in (False, True):
+        _align(OperatorType.OP_SDPA,
+               {"dropout": 0.0, "causal": causal, "scale": None},
+               [q, k, v], {},
+               lambda ti, tp, c=causal: torch.nn.functional.
+               scaled_dot_product_attention(ti[0], ti[1], ti[2],
+                                            is_causal=c),
+               rtol=1e-3, atol=1e-4)
+
+    _align(OperatorType.OP_SDPA,
+           {"dropout": 0.0, "causal": False, "scale": 0.5},
+           [q, k, v], {},
+           lambda ti, tp: torch.nn.functional.scaled_dot_product_attention(
+               ti[0], ti[1], ti[2], scale=0.5),
+           rtol=1e-3, atol=1e-4)

@@ -95,3 +95,24 @@ def test_strategy_export_import(tmp_path):
     # specs survive the round trip
     for guid, ns in ff.strategy.node_strategies.items():
         assert s2.node_strategies[guid].weight_specs == ns.weight_specs
+
+
+def test_initialize_multihost_single_host_noop():
+    """Safe on single host: returns process 0 without raising."""
+    from flexflow_tpu.parallel.mesh import initialize_multihost
+
+    assert initialize_multihost() == 0
+
+
+def test_build_hybrid_mesh_validation_and_shape():
+    import pytest
+
+    from flexflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    with pytest.raises(ValueError, match="equal rank"):
+        build_hybrid_mesh((8,), (2, 1), ("data", "model"))
+    with pytest.raises(ValueError, match="axis names"):
+        build_hybrid_mesh((1, 8), (2, 1), ("data",))
+    # 8 virtual devices: 2 "slices" x (1, 4) chips -> mesh (2, 4)
+    mesh = build_hybrid_mesh((1, 4), (2, 1), ("data", "model"))
+    assert dict(mesh.shape) == {"data": 2, "model": 4}
