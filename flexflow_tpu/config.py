@@ -96,6 +96,10 @@ class FFConfig:
     # observability
     profiling: bool = False
     perform_auto_mapping: bool = False
+    # numerical-safety checks — the TPU analog of the reference's reliance on
+    # Legion region coherence for race freedom (SURVEY §5: XLA purity plays
+    # that role; this adds jax_debug_nans on top)
+    debug_nans: bool = False
 
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
@@ -190,6 +194,8 @@ class FFConfig:
                 self.num_nodes = int(_next())
             elif a == "--profiling":
                 self.profiling = True
+            elif a == "--debug-nans":
+                self.debug_nans = True
             elif a == "--taskgraph":
                 self.export_strategy_task_graph_file = _next()
             elif a == "--include-costs-dot-graph":

@@ -87,8 +87,25 @@ class KerasExpModel:
                              use_bias=layer.use_bias, name=layer.name)
         if isinstance(layer, (keras.layers.MaxPooling2D,
                               keras.layers.AveragePooling2D)):
-            ph = layer.pool_size[0] // 2 if layer.padding == "same" else 0
-            pw = layer.pool_size[1] // 2 if layer.padding == "same" else 0
+            # keras 'same' pads to ceil(n/stride) windows:
+            # total = max(0, (ceil(n/s)-1)*s + pool - n); pool2d takes
+            # symmetric padding, so reject layers needing asymmetric pads
+            ph = pw = 0
+            if layer.padding == "same":
+                in_shape = layer.input.shape  # (batch, C, H, W) or NHWC
+                spatial = (in_shape[2], in_shape[3]) if len(in_shape) == 4 \
+                    else (None, None)
+                pads = []
+                for n, p, s in zip(spatial, layer.pool_size, layer.strides):
+                    if n is None:
+                        pads.append(0)
+                        continue
+                    total = max(0, (-(-int(n) // s) - 1) * s + p - int(n))
+                    if total % 2:
+                        raise NotImplementedError(
+                            "keras_exp: asymmetric 'same' pooling padding")
+                    pads.append(total // 2)
+                ph, pw = pads
             pt = (PoolType.POOL_MAX
                   if isinstance(layer, keras.layers.MaxPooling2D)
                   else PoolType.POOL_AVG)

@@ -452,6 +452,8 @@ class FFModel:
         # -- mesh + strategy ----------------------------------------------------
         import jax
 
+        if self.config.debug_nans:
+            jax.config.update("jax_debug_nans", True)
         devices = jax.devices()
         n_dev = len(devices)
         if strategy_fn is not None:

@@ -2,8 +2,9 @@
 reduce, mean, topk, batch_matmul.
 
 Reference: src/ops/{reshape,transpose,reverse,concat,split,gather,reduce,mean,
-topk,batch_matmul}.cc. All are single XLA HLO ops here; top-k keeps a custom
-Pallas path (kernels/topk.py) for the MoE hot loop.
+topk,batch_matmul}.cc. All are single XLA HLO ops here — including top-k
+(``jax.lax.top_k``), where the reference needs a hand-written GPU kernel
+(topk.cu:514) but XLA's TPU sort is already tuned for the MoE routing shapes.
 """
 from __future__ import annotations
 
@@ -161,8 +162,8 @@ class MeanOp(ReduceMeanOp):
 @register_op(OperatorType.OP_TOPK)
 class TopKOp(Op):
     """attrs: k, sorted. outputs: (values, indices) over last dim
-    (reference: src/ops/topk.cc:437, custom GPU kernel — here lax.top_k,
-    with a Pallas variant in kernels/topk.py for MoE routing)."""
+    (reference: src/ops/topk.cc:437, custom GPU kernel — here lax.top_k;
+    XLA's TPU sort covers the MoE routing shapes)."""
 
     def infer_output_shapes(self, input_shapes):
         s = input_shapes[0]

@@ -61,12 +61,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     47-52 — here one call: XLA then runs collectives over ICI within a slice
     and DCN across hosts automatically).
 
-    On TPU pods the arguments are auto-detected from the environment; on
-    other platforms pass them explicitly. Returns the process index.
-    Idempotent: calling again after successful init is a no-op; a real
-    connection failure (bad coordinator, unreachable hosts) propagates —
-    silently degrading to independent single-host runs would corrupt a
-    multi-host job.
+    Call BEFORE any other jax use (device queries, computation). On TPU pods
+    the arguments are auto-detected from the environment; on other platforms
+    pass them explicitly. Returns the process index. Idempotent after a
+    successful init; plain single-host auto mode is a no-op. Any real
+    failure — bad coordinator, unreachable hosts, or calling too late —
+    propagates: silently degrading to independent single-host runs would
+    corrupt a multi-host job.
     """
     import jax
 
@@ -75,13 +76,11 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
                                    num_processes=num_processes,
                                    process_id=process_id)
     except RuntimeError as e:
-        msg = str(e).lower()
-        benign = ("already initialized" in msg
-                  or "must be called before" in msg)
-        # auto-detected single-host (no explicit coordinator): benign no-op;
-        # an explicit coordinator that fails must propagate — silently
-        # degrading to independent single-host runs would corrupt the job
-        if coordinator_address is not None or not benign:
+        # ONLY "already initialized" is benign; everything else (incl.
+        # "must be called before any JAX computations", which means init did
+        # NOT happen) must propagate — silently degrading to independent
+        # single-host runs would corrupt a multi-host job
+        if "already initialized" not in str(e).lower():
             raise
     except ValueError:
         if coordinator_address is not None:

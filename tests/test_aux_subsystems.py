@@ -132,3 +132,33 @@ def test_dot_export(tmp_path):
     ff = _small_model()
     dot = ff.pcg.to_dot()
     assert "digraph PCG" in dot and "OP_LINEAR" in dot
+
+
+def test_debug_nans_flag(rng):
+    """--debug-nans surfaces NaNs from the jitted step (the TPU analog of
+    the reference's race-freedom-by-construction story, SURVEY §5)."""
+    import jax
+    import jax.random as jrandom
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+
+    config = FFConfig()
+    config.parse_args(["--debug-nans"])
+    assert config.debug_nans
+    config.batch_size = 4
+    ff = FFModel(config)
+    x_t = ff.create_tensor((4, 8))
+    t = ff.log(x_t)  # log of negative input -> NaN
+    ff.dense(t, 3)
+    try:
+        ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+        x = -np.abs(rng.normal(size=(4, 8))).astype(np.float32) - 1.0
+        y = rng.normal(size=(4, 3)).astype(np.float32)
+        step = ff.executor.make_train_step()
+        import pytest
+
+        with pytest.raises(FloatingPointError):
+            out = step(ff.params, ff.opt_state, [x], y, jrandom.PRNGKey(0))
+            jax.block_until_ready(out)
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -98,10 +98,33 @@ def test_strategy_export_import(tmp_path):
 
 
 def test_initialize_multihost_single_host_noop():
-    """Safe on single host: returns process 0 without raising."""
+    """Auto mode on a plain single host (fresh interpreter, called before any
+    other jax use — the documented contract) returns process 0; a failing
+    EXPLICIT coordinator propagates."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "from flexflow_tpu.parallel.mesh import initialize_multihost\n"
+        "assert initialize_multihost() == 0\n"
+        "print('MULTIHOST_NOOP_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=120)
+    assert "MULTIHOST_NOOP_OK" in r.stdout, (r.stdout, r.stderr)
+
+    # late call after jax use must NOT silently skip init
+    import pytest
+
     from flexflow_tpu.parallel.mesh import initialize_multihost
 
-    assert initialize_multihost() == 0
+    with pytest.raises(RuntimeError, match="must be called before"):
+        initialize_multihost()
 
 
 def test_build_hybrid_mesh_validation_and_shape():
