@@ -124,7 +124,7 @@ def main():
     peak = detect_peak_flops() if on_tpu else 1e12
     mfu = achieved / peak
 
-    print(json.dumps({
+    result = {
         "metric": "bert_large_train_mfu_1chip" if on_tpu
         else "bert_tiny_train_cpu_smoke",
         "value": round(mfu, 4),
@@ -133,7 +133,55 @@ def main():
         "samples_per_sec": round(samples_per_sec, 2),
         "step_ms": round(dt * 1e3, 2),
         "model_flops_per_step": flops_per_step,
-    }))
+    }
+    if on_tpu:
+        result.update(cost_model_checks(ff, config, dt))
+    print(json.dumps(result))
+
+
+def cost_model_checks(ff, config, measured_step_s: float) -> dict:
+    """(a) Ground the analytical cost model with on-device per-op
+    measurements and check the simulated step time is within 2x of the
+    measured one (reference: Simulator::measure_operator_cost ground truth,
+    simulator.cc:489). (b) Run the OSDI'22 searched-vs-DP protocol on the
+    calibrated simulator at 8 chips (scripts/osdi22ae/bert.sh:3-7) and
+    record the speedup the search claims over pure data parallelism."""
+    out = {}
+    try:
+        from flexflow_tpu.search.machine_model import TPUMachineModel
+        from flexflow_tpu.search.simulator import OpSharding, Simulator
+        from flexflow_tpu.search.unity import simulate_best, unity_search
+
+        pcg = ff.pcg
+        import jax.numpy as jnp
+
+        machine1 = TPUMachineModel.detect(1)
+        sim = Simulator(machine1)
+        n_cal = sim.calibrate_from_pcg(pcg, max_ops=12,
+                                       compute_dtype=jnp.bfloat16)
+        dp1 = {n.guid: OpSharding(dp=1) for n in pcg.compute_nodes()}
+        sim_t = simulate_best(sim, pcg, dp1, {})
+        out["sim_step_ms"] = round(sim_t * 1e3, 2)
+        out["sim_vs_measured"] = round(sim_t / measured_step_s, 3)
+        out["sim_calibrated_ops"] = n_cal
+        out["sim_within_2x"] = bool(
+            0.5 <= sim_t / measured_step_s <= 2.0)
+
+        # searched vs DP at 8 chips on the device-calibrated model (the
+        # calibrated simulator must be the one the search costs with)
+        machine8 = TPUMachineModel.detect(8)
+        sim8 = Simulator(machine8)
+        sim8._key_calibration = dict(sim._key_calibration)
+        res = unity_search(pcg.copy(), config, 8, machine=machine8,
+                           return_result=True, insert_ir_nodes=False,
+                           sim=sim8)
+        dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+        t_dp = simulate_best(sim8, pcg, dp8, {})
+        out["searched_vs_dp_8chip_sim"] = round(t_dp / res.sim_time, 3)
+        out["searched_mesh"] = list(res.mesh_shape)
+    except Exception as e:  # cost-model check must never sink the bench
+        out["cost_model_check_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
 
 
 if __name__ == "__main__":

@@ -67,6 +67,9 @@ class FFConfig:
     enable_sample_parallel: bool = True
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
+    # TPU-native extension: sequence/context parallelism (ring attention) in
+    # the search space; no reference analog (SURVEY §5 long-context)
+    enable_sequence_parallel: bool = True
     enable_inplace_optimizations: bool = True
     search_num_nodes: int = -1
     search_num_workers: int = -1
@@ -158,6 +161,8 @@ class FFConfig:
                 self.enable_parameter_parallel = True
             elif a == "--enable-attribute-parallel":
                 self.enable_attribute_parallel = True
+            elif a == "--disable-sequence-parallel":
+                self.enable_sequence_parallel = False
             elif a == "--fusion":
                 self.perform_fusion = True
             elif a == "--memory-search":

@@ -192,6 +192,11 @@ class OperatorType(enum.IntEnum):
     # attention core without projections (torch F.scaled_dot_product_attention;
     # reference analog: the cuDNN MHA core inside attention.cu)
     OP_SDPA = 115
+    # batched expert FFN: all experts' weights stacked into one (n, d_in,
+    # d_out) tensor driven by batched matmul — the TPU-native (GShard-style)
+    # form of the reference's per-expert Linear nodes fed by group_by
+    # (src/ops/group_by.cc), shardable over the expert dim for EP
+    OP_EXPERTS = 116
 
 
 # --- dtype helpers -------------------------------------------------------------

@@ -574,10 +574,13 @@ class FFModel:
 
         try:
             from .search.unity import unity_search
-
-            return unity_search(pcg, self.config, n_dev)
         except ImportError:
             return data_parallel_strategy(pcg, n_dev)
+        # the final (loss-anchored) node must survive graph rewrites so the
+        # label tensor and executor anchor stay valid (the reference protects
+        # its sink the same way via the output-shape contract)
+        return unity_search(pcg, self.config, n_dev,
+                            protected_guids=(self.final_guid,))
 
     # ============================================================ training ==
     def _next_rng(self):

@@ -89,6 +89,44 @@ class PCG:
                 if n.op.op_type not in (OperatorType.OP_INPUT,
                                         OperatorType.OP_WEIGHT)]
 
+    def insert_node_on_edge(self, consumer_guid: int, input_idx: int,
+                            op: Op) -> PCGNode:
+        """Insert ``op`` on the edge feeding ``consumer_guid``'s input slot
+        ``input_idx`` (reference: the search inserting parallel ops into the
+        PCG, substitution.cc GraphXfer::run). The new node is placed in the
+        order right before the consumer, preserving topological validity."""
+        consumer = self.nodes[consumer_guid]
+        g, i = consumer.inputs[input_idx]
+        src = self.nodes[g]
+        node = PCGNode(guid=next(_node_guid), op=op, inputs=[(g, i)],
+                       out_shapes=[src.out_shapes[i]],
+                       out_dtypes=[src.out_dtypes[i]])
+        self.nodes[node.guid] = node
+        self._order.insert(self._order.index(consumer_guid), node.guid)
+        consumer.inputs[input_idx] = (node.guid, 0)
+        return node
+
+    def retopo(self) -> None:
+        """Restore ``_order`` to a topological order (Kahn) after a rewrite
+        appended nodes out of place."""
+        indeg: Dict[int, int] = {g: 0 for g in self.nodes}
+        outs: Dict[int, List[int]] = {g: [] for g in self.nodes}
+        for n in self.nodes.values():
+            for g, _ in n.inputs:
+                indeg[n.guid] += 1
+                outs[g].append(n.guid)
+        ready = [g for g in self._order if indeg[g] == 0]
+        order: List[int] = []
+        while ready:
+            g = ready.pop(0)
+            order.append(g)
+            for c in outs[g]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        assert len(order) == len(self.nodes), "cycle after rewrite"
+        self._order = order
+
     # -- structural hash (reference: Graph::hash) -------------------------------
     def hash(self) -> int:
         h = 17

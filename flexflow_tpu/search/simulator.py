@@ -59,15 +59,21 @@ class OpSharding:
     degree, and how the model degree is applied. TPU-native MachineView
     (SURVEY §7: the searched space of the reference's
     register_all_machine_views is 1-D divisor-degree views — (dp, tp)
-    factorizations cover it)."""
+    factorizations cover it).
+
+    ``act_tp`` covers pass-through sharded states (kind == "none" but the
+    activation rides the model axis in state S or Q): the op's compute and
+    activation memory shard over dp*act_tp while its weights stay
+    replicated — e.g. a per-token dense inside a sequence-parallel region."""
 
     dp: int = 1
     tp: int = 1
-    kind: str = "none"  # none|col|row|heads|table|expert
+    kind: str = "none"  # none|col|row|heads|table|expert|ring
+    act_tp: int = 1
 
     @property
     def degree(self) -> int:
-        return self.dp * (self.tp if self.kind != "none" else 1)
+        return self.dp * (self.tp if self.kind != "none" else self.act_tp)
 
 
 class Simulator:
@@ -76,7 +82,12 @@ class Simulator:
         self.machine = machine
         self.overlap = overlap_backward_update
         self._measure_cache: Dict[Tuple, float] = {}
-        self.calibration = 1.0  # measured/analytical scale factor
+        self.calibration = 1.0  # global measured/analytical scale factor
+        # per-op-key measured/analytical ratios (reference: the per-(op,view)
+        # cost cache of simulator.cc:489; here per op-shape, scaled
+        # analytically across shardings)
+        self._key_calibration: Dict[Tuple, float] = {}
+        self._dispatch_overhead: Optional[float] = None
 
     # ------------------------------------------------------------ per-op cost
     def op_cost(self, node: PCGNode, in_shapes: List[Tuple[int, ...]],
@@ -93,16 +104,19 @@ class Simulator:
                       for spec in op.weight_specs(in_shapes).values()) * el
 
         deg = max(sh.degree, 1)
+        w_shard_kinds = ("col", "row", "heads", "table", "expert")
+        w_div = max(sh.tp if sh.kind in w_shard_kinds else 1, 1)
         shard_flops = flops / deg
-        shard_bytes = (in_bytes + out_bytes) / deg + w_bytes / max(
-            sh.tp if sh.kind in ("col", "row", "heads", "table") else 1, 1)
+        shard_bytes = (in_bytes + out_bytes) / deg + w_bytes / w_div
 
         if op.op_type in _MATMUL_OPS:
             compute = shard_flops / (m.peak_flops * m.matmul_efficiency)
         else:
             compute = shard_flops / (m.peak_flops_f32 * m.matmul_efficiency)
         mem_time = shard_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
-        fwd = max(compute, mem_time) * self.calibration
+        cal = self._key_calibration.get(self._op_key(node, in_shapes),
+                                        self.calibration)
+        fwd = max(compute, mem_time) * cal
         # backward ~ 2x forward for weight-bearing ops, 1x otherwise
         bwd = fwd * (2.0 if w_bytes else 1.0)
 
@@ -110,22 +124,30 @@ class Simulator:
         comm = 0.0
         if sh.kind in ("row", "heads", "table") and sh.tp > 1:
             comm = m.allreduce_time(out_bytes // max(sh.dp, 1), sh.tp)
+        elif sh.kind == "ring" and sh.tp > 1:
+            # ring attention (sequence parallel): (tp-1) rounds passing the
+            # local k/v shards around the ring (kernels/ring_attention.py);
+            # k+v are 2 of the 3 equally-sized self-attention inputs
+            kv_per_chip = int(2 * in_bytes / 3) // deg
+            comm = m.allgather_time(kv_per_chip, sh.tp)
+        elif sh.kind == "expert" and sh.tp > 1:
+            # expert parallel: all-to-all token exchange in and out
+            comm = 2 * m.alltoall_time(in_bytes // deg, sh.tp)
 
-        # gradient sync: weights replicated over dp -> allreduce over dp
+        # gradient sync: weights replicated over dp -> allreduce over dp;
+        # ring attention and pass-through SP states replicate weights over tp
+        # too, so their grads reduce over dp*tp
         sync = 0.0
-        if w_bytes and sh.dp > 1:
-            shard_w = w_bytes // max(
-                sh.tp if sh.kind in ("col", "row", "heads", "table") else 1, 1)
-            sync = m.allreduce_time(shard_w, sh.dp)
+        sync_n = sh.dp * (sh.tp if sh.kind == "ring" else sh.act_tp)
+        if w_bytes and sync_n > 1:
+            sync = m.allreduce_time(w_bytes // w_div, sync_n)
 
         return CostMetrics(
             forward_time=fwd, backward_time=bwd, sync_time=sync,
             comm_time=comm,
             inputs_memory=int(in_bytes / deg),
             outputs_memory=int(out_bytes / deg),
-            weights_memory=int(w_bytes / max(
-                sh.tp if sh.kind in ("col", "row", "heads", "table") else 1,
-                1)))
+            weights_memory=int(w_bytes / w_div))
 
     # ----------------------------------------------------- transition costs
     def resharding_cost(self, bytes_total: int, src_state: str,
@@ -133,16 +155,20 @@ class Simulator:
         """Cost of moving an activation between sharding states.
 
         States: 'R' = sharded over data only (replicated over model axis),
-        'S' = additionally sharded over the model axis. These are the
-        Repartition/Combine parallel ops of the reference (src/parallel_ops/):
-        R->S is a local slice (free); S->R is an all-gather over tp.
+        'S' = additionally sharded over the model (hidden) axis, 'Q' =
+        additionally sharded over the sequence dim. These transitions are the
+        Repartition/Combine/AllToAll parallel ops of the reference
+        (src/parallel_ops/): R->{S,Q} is a local slice (free), {S,Q}->R is an
+        all-gather over tp, S<->Q is an all-to-all over tp.
         """
         if src_state == dst_state or tp <= 1:
             return 0.0
         per_chip = bytes_total // max(dp * tp, 1)
-        if src_state == "S" and dst_state == "R":
+        if dst_state == "R":
             return self.machine.allgather_time(per_chip, tp)
-        return 0.0  # R->S: local slice
+        if src_state == "R":
+            return 0.0  # R->S / R->Q: local slice
+        return self.machine.alltoall_time(per_chip, tp)  # S<->Q
 
     # ------------------------------------------------------- whole-graph sim
     def simulate(self, pcg: PCG,
@@ -270,15 +296,64 @@ class Simulator:
             np.asarray(edst, dtype=np.int32))
 
     # -------------------------------------------- measured mode (on device)
+    @staticmethod
+    def _op_key(node: PCGNode, in_shapes: List[Tuple[int, ...]]) -> Tuple:
+        return (node.op.params_key(), tuple(map(tuple, in_shapes)))
+
+    def calibrate_from_pcg(self, pcg: PCG, max_ops: int = 64,
+                           compute_dtype=None) -> int:
+        """Measure every distinct op shape in the graph on the current backend
+        and store per-key measured/analytical ratios, so ``op_cost`` returns
+        device-calibrated times (reference: Simulator::measure_operator_cost
+        ground truth feeding graph_cost, simulator.cc:489). Returns the number
+        of distinct ops measured. Cheap on repetitive graphs: BERT-Large has
+        ~7 distinct op shapes across 24 layers."""
+        measured = 0
+        for node in pcg.compute_nodes():
+            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+            key = self._op_key(node, in_shapes)
+            if key in self._key_calibration:
+                continue
+            if measured >= max_ops:
+                break
+            analytical = self.op_cost(node, in_shapes,
+                                      OpSharding()).forward_time
+            if analytical <= 0:
+                continue
+            try:
+                t = self.measure_operator_cost(node, in_shapes,
+                                               compute_dtype=compute_dtype)
+            except Exception:
+                continue  # op not measurable standalone (e.g. host-side)
+            if t > 0:
+                self._key_calibration[key] = t / analytical
+                measured += 1
+        return measured
+
     def measure_operator_cost(self, node: PCGNode,
                               in_shapes: List[Tuple[int, ...]],
-                              iters: int = 5) -> float:
+                              iters: Optional[int] = None,
+                              compute_dtype=None) -> float:
         """Time one op standalone on the current backend, cached by params key
-        (reference: measure_operator_cost, simulator.cc:489 — cudaEvents;
-        here wall clock around a host readback)."""
-        key = (node.op.params_key(), tuple(map(tuple, in_shapes)))
+        (reference: measure_operator_cost, simulator.cc:489 — cudaEvents).
+
+        All ``iters`` applications run inside ONE jitted ``lax.scan`` whose
+        carry chains each iteration's inputs to the previous output's
+        sum-of-squares — the data dependency serializes iterations and
+        defeats both CSE and XLA's slice/reduction factoring (a plain sum of
+        a matmul is algebraically reducible to a cheap vector dot; a [0]
+        slice computes one element). Tunneled TPU platforms add a ~75 ms
+        round trip per call under which async dispatch hides device work, so
+        ``iters`` is sized from the analytical estimate to push total device
+        time well past the round trip, which is separately measured with an
+        identity jit and subtracted."""
+        key = self._op_key(node, in_shapes)
         if key in self._measure_cache:
             return self._measure_cache[key]
+        if iters is None:
+            est = self.op_cost(node, in_shapes, OpSharding()).forward_time
+            # target ~0.4 s of device work (≳5x the observed ~75 ms RTT)
+            iters = int(min(max(0.4 / max(est, 1e-6), 16), 4096))
         import time
 
         import jax
@@ -288,25 +363,51 @@ class Simulator:
         from ..ops.base import OpContext
 
         op = node.op
-        dt = dtype_to_jnp(op.data_type)
+        dt = compute_dtype or dtype_to_jnp(op.data_type)
         xs = [jnp.ones(s, dt) for s in in_shapes]
         params = {}
         key_rng = jax.random.PRNGKey(0)
         for wname, (shape, wdt, init) in op.weight_specs(in_shapes).items():
-            params[wname] = init(key_rng, shape, dtype_to_jnp(wdt))
+            w = init(key_rng, shape, dtype_to_jnp(wdt))
+            if compute_dtype is not None and jnp.issubdtype(
+                    w.dtype, jnp.floating):
+                w = w.astype(compute_dtype)
+            params[wname] = w
         ctx = OpContext(training=False)
 
         @jax.jit
         def f(params, xs):
-            return op.forward(params, list(xs), ctx)
+            def body(carry, _):
+                cur, acc = carry
+                outs = op.forward(params, cur, ctx)
+                leaf = jax.tree_util.tree_leaves(outs)[0].astype(jnp.float32)
+                s = jnp.vdot(leaf, leaf) * 1e-30
+                nxt = [x * (1.0 + s).astype(x.dtype) if jnp.issubdtype(
+                    x.dtype, jnp.floating) else x for x in cur]
+                return (nxt, acc + s), ()
 
-        outs = f(params, xs)
-        _ = np.asarray(jax.tree_util.tree_leaves(outs)[0]).ravel()[0]
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            outs = f(params, xs)
-        _ = np.asarray(jax.tree_util.tree_leaves(outs)[0]).ravel()[0]
-        t = (time.perf_counter() - t0) / iters
+            (_, acc), _ = jax.lax.scan(body, (list(xs), jnp.zeros(())),
+                                       None, length=iters)
+            return acc
+
+        def timed(fn, *args):
+            out = fn(*args)  # compile + settle
+            _ = float(np.asarray(out))
+            best = float("inf")
+            for _i in range(3):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                _ = float(np.asarray(out))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        if self._dispatch_overhead is None:
+            ident = jax.jit(lambda x: x * 1.000001)
+            probe = jnp.ones((8, 8), jnp.float32)
+            self._dispatch_overhead = timed(
+                lambda x: jnp.sum(ident(x)), probe)
+        total = timed(f, params, xs)
+        t = max((total - self._dispatch_overhead) / iters, 1e-7)
         self._measure_cache[key] = t
         return t
 

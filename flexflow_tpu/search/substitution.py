@@ -50,11 +50,32 @@ _NAME_TO_OP = {
 class OpX:
     """Pattern node (reference: substitution.h:64-110): an op type plus
     input slots referencing other pattern nodes (by index) or open inputs
-    (negative)."""
+    (negative).
+
+    src side: ``attr_constraints`` filters matches — a value, a tuple of
+    admissible values, or a callable predicate.
+    dst side: ``attrs_from`` names the src OpX index whose matched node's
+    attrs seed the new op (default: first src OpX of the same type), then
+    ``attr_overrides`` are applied on top."""
 
     op_type: OperatorType
     inputs: List[int]  # >=0: OpX index in pattern; <0: open input slot
     attr_constraints: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    attrs_from: Optional[int] = None
+    attr_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def constraint_ok(self, attrs: Dict[str, Any]) -> bool:
+        for k, v in self.attr_constraints.items():
+            got = attrs.get(k)
+            if callable(v):
+                if not v(got):
+                    return False
+            elif isinstance(v, tuple):
+                if got not in v:
+                    return False
+            elif got != v:
+                return False
+        return True
 
 
 @dataclasses.dataclass
@@ -91,10 +112,8 @@ class GraphXfer:
                                 cand.inputs[slot][0] != mapping.get(pin):
                             ok = False
                             break
-                for k, v in px.attr_constraints.items():
-                    if cand.op.attrs.get(k) != v:
-                        ok = False
-                        break
+                if ok and not px.constraint_ok(cand.op.attrs):
+                    ok = False
                 if ok:
                     mapping[i] = cand.guid
                     backtrack(i + 1, mapping)
@@ -116,6 +135,83 @@ class GraphXfer:
             if valid:
                 out.append(m)
         return out
+
+    def apply(self, pcg: PCG, match: Dict[int, int]) -> PCG:
+        """Apply the rewrite on a copy of ``pcg`` (reference:
+        GraphXfer::run, substitution.cc — create_new_operator + rewire).
+
+        Convention: the LAST src OpX is the pattern's output node; its
+        external consumers are rewired to the LAST dst node's output 0. Open
+        input slots bind to the matched nodes' actual producers. The new op's
+        attrs come from ``attrs_from`` (see OpX) so shape-bearing parameters
+        (out_dim, num_heads, ...) carry over. Shapes must be preserved by the
+        rule — verified, ValueError otherwise."""
+        from ..ops.base import op_class_for
+
+        g = pcg.copy()
+        # open-input bindings: pattern slot id -> (producer_guid, out_idx)
+        bindings: Dict[int, tuple] = {}
+        for i, px in enumerate(self.src):
+            node = g.nodes[match[i]]
+            for slot, pin in enumerate(px.inputs):
+                if pin < 0 and slot < len(node.inputs):
+                    bindings[pin] = node.inputs[slot]
+
+        out_src_guid = match[len(self.src) - 1]
+        old_out = g.nodes[out_src_guid]
+
+        new_nodes = []
+        for j, dx in enumerate(self.dst):
+            src_idx = dx.attrs_from
+            if src_idx is None:
+                for i, px in enumerate(self.src):
+                    if px.op_type == dx.op_type:
+                        src_idx = i
+                        break
+            attrs = dict(g.nodes[match[src_idx]].op.attrs) \
+                if src_idx is not None else {}
+            attrs.update(dx.attr_overrides)
+            template = g.nodes[match[src_idx]] if src_idx is not None \
+                else old_out
+            inputs = []
+            for pin in dx.inputs:
+                if pin >= 0:
+                    inputs.append((new_nodes[pin].guid, 0))
+                else:
+                    if pin not in bindings:
+                        raise ValueError(
+                            f"{self.name}: unbound open input {pin}")
+                    inputs.append(bindings[pin])
+            # the output node inherits its attrs-template's name: it carries
+            # that node's weights (e.g. the fused Linear keeps the original
+            # Linear's name), so name-keyed weight mapping — frontends'
+            # copy_torch_weights, checkpoints — survives the rewrite
+            if j == len(self.dst) - 1 and src_idx is not None:
+                name = template.op.name
+            else:
+                name = f"{self.name}_{j}_g{old_out.guid}"
+            op = op_class_for(dx.op_type)(
+                name, attrs, template.op.data_type, num_inputs=len(inputs))
+            node = g.add_node(op, inputs)
+            new_nodes.append(node)
+
+        new_out = new_nodes[-1]
+        if new_out.out_shapes[0] != old_out.out_shapes[0]:
+            raise ValueError(
+                f"{self.name}: rewrite changes output shape "
+                f"{old_out.out_shapes[0]} -> {new_out.out_shapes[0]}")
+        # rewire external consumers of the pattern output
+        for n in g.nodes.values():
+            if n.guid == new_out.guid:
+                continue
+            n.inputs = [(new_out.guid, i) if pg == out_src_guid
+                        else (pg, i) for pg, i in n.inputs]
+        # drop all matched nodes
+        for guid in match.values():
+            del g.nodes[guid]
+            g._order.remove(guid)
+        g.retopo()
+        return g
 
 
 def load_substitution_json(path: str) -> List[GraphXfer]:
@@ -180,17 +276,31 @@ def fuse_consecutive_reshapes(pcg: PCG) -> int:
 
 
 def builtin_xfers() -> List[GraphXfer]:
-    """Hand-registered patterns mirroring the reference's manual xfers
-    (substitution.cc:3041-3226). The parallelization variants are realized by
-    the DP search; these document the pattern shapes for the JSON engine."""
-    return [
-        GraphXfer(
-            "linear_relu_fuse",
-            src=[OpX(OperatorType.OP_LINEAR, [-1]),
-                 OpX(OperatorType.OP_RELU, [0])],
-            dst=[OpX(OperatorType.OP_LINEAR, [-1],
-                     {"activation": "relu"})]),
-    ]
+    """Hand-registered rewrite rules mirroring the reference's manual xfers
+    (substitution.cc:3041-3226). The parallelization variants
+    (partition/replicate + combine) are realized natively by the DP search's
+    sharding states (unity.node_options); the algebraic rules here fuse a
+    Linear with a following activation into the Linear's fused-activation
+    form (the reference's cuBLAS GEMM + fused activation epilogue,
+    src/ops/kernels/linear_kernels.cu) — applied by best_first_optimize when
+    the simulator approves."""
+    from ..ffconst import ActiMode
+
+    none_act = (None, ActiMode.AC_MODE_NONE)
+    xfers = []
+    for act_op, mode, name in [
+            (OperatorType.OP_RELU, ActiMode.AC_MODE_RELU, "relu"),
+            (OperatorType.OP_SIGMOID, ActiMode.AC_MODE_SIGMOID, "sigmoid"),
+            (OperatorType.OP_TANH, ActiMode.AC_MODE_TANH, "tanh"),
+            (OperatorType.OP_GELU, ActiMode.AC_MODE_GELU, "gelu")]:
+        xfers.append(GraphXfer(
+            f"linear_{name}_fuse",
+            src=[OpX(OperatorType.OP_LINEAR, [-1],
+                     {"activation": none_act}),
+                 OpX(act_op, [0])],
+            dst=[OpX(OperatorType.OP_LINEAR, [-1], attrs_from=0,
+                     attr_overrides={"activation": mode})]))
+    return xfers
 
 
 def apply_simplifications(pcg: PCG) -> int:

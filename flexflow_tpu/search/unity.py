@@ -1,34 +1,47 @@
 """Unity-style auto-parallelization search, TPU-native.
 
 Rebuild of the reference's search stack (SURVEY §2.1 L4a): GraphSearchHelper's
-outer optimization (substitution.cc:1898), SearchHelper's DP over per-node
-MachineViews (graph.h:170-283), memory-aware λ search (graph.cc:2060-2133),
-and the legacy MCMC fallback (model.cc:3285).
+outer substitution loop (substitution.cc:1898, base_optimize :2229),
+SearchHelper's DP over per-node MachineViews (graph.h:170-283), memory-aware λ
+search (graph.cc:2060-2133), and the legacy MCMC fallback (model.cc:3285).
 
 TPU-native reformulation (SURVEY §7): the reference searches over graph
 substitutions that insert partition/combine/replicate/reduction nodes and
 assigns 1-D divisor-degree MachineViews (register_all_machine_views,
-graph.cc:2329). Under XLA SPMD that space is exactly: (a) a mesh factorization
+graph.cc:2329). Under XLA SPMD that space is: (a) a mesh factorization
 (dp, tp) of the chip count, and (b) a per-op choice of how the tp axis is
-applied (none / column / row / heads / table / expert) with resharding
-transitions between choices. The search here:
+applied, with resharding transitions between choices. The per-op state is the
+activation's sharding class:
 
-  outer loop over (dp, tp) factorizations     == enumerating MachineView grids
-  per-chain Viterbi DP over sharding states   == find_optimal_sequence_graph_time
-  transition costs from the Simulator         == estimate_xfer_cost
-  alpha pruning + budget                      == base_optimize's best-first prune
-  memory λ binary search                      == graph_optimize_task λ loop
-  MCMC fallback (--search-budget, no DP)      == FFModel::mcmc_optimize
+  'R'  batch-sharded over dp only (replicated over the model axis)
+  'S'  additionally sharded over the hidden (last) dim      — Megatron TP
+  'Q'  additionally sharded over the sequence dim           — sequence/SP
 
-The output is a Strategy (per-op shardings) — the same artifact the reference
+and the per-op kinds: none | col | row | heads | table | expert | ring.
+Transitions pay the collective the matching parallel op would run
+(Repartition = free slice, Combine = all-gather, AllToAll for S<->Q —
+src/parallel_ops/), and ``insert_parallel_ops`` materializes those transitions
+as first-class parallel-op PCG nodes, matching the reference's search output.
+
+  outer best-first loop over GraphXfer rewrites  == base_optimize
+  outer loop over (dp, tp) factorizations        == enumerating MachineViews
+  per-graph DP over {R,S,Q} sharding states      == graph_cost<T>
+  transition costs from the Simulator            == estimate_xfer_cost
+  alpha pruning + budget                         == base_optimize's prune
+  memory λ binary search                         == graph_optimize_task λ loop
+  MCMC fallback                                  == FFModel::mcmc_optimize
+
+The output is a Strategy (per-op shardings) — the artifact the reference
 serializes as optimal_views.
 """
 from __future__ import annotations
 
 import dataclasses
+import heapq
+import itertools
 import math
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -42,18 +55,8 @@ from .simulator import OpSharding, Simulator
 
 _log = RecursiveLogger("unity")
 
-# per-op tp options: (kind, required input state, produced output state)
-#   states: 'R' = batch-sharded only; 'S' = also sharded over the model axis
-_TP_OPTIONS: Dict[OperatorType, List[Tuple[str, str, str]]] = {
-    OperatorType.OP_LINEAR: [("none", "R", "R"), ("col", "R", "S"),
-                             ("row", "S", "R")],
-    OperatorType.OP_MULTIHEAD_ATTENTION: [("none", "R", "R"),
-                                          ("heads", "R", "R")],
-    OperatorType.OP_EMBEDDING: [("none", "R", "R"), ("table", "R", "R")],
-    OperatorType.OP_CONV2D: [("none", "R", "R"), ("col", "R", "S")],
-}
-# state-preserving ops (elementwise etc.) pass S through; everything else
-# demands R input
+# state-preserving ops (elementwise etc.): pass R through; pass S/Q through
+# when the sharded dim divides
 _STATE_PRESERVING = {
     OperatorType.OP_RELU, OperatorType.OP_GELU, OperatorType.OP_TANH,
     OperatorType.OP_SIGMOID, OperatorType.OP_ELU, OperatorType.OP_IDENTITY,
@@ -62,6 +65,36 @@ _STATE_PRESERVING = {
     OperatorType.OP_SCALAR_TRUE_DIV, OperatorType.OP_CAST,
     OperatorType.OP_EXP, OperatorType.OP_POW,
 }
+_ELEMENTWISE_BINARY = {
+    OperatorType.OP_EW_ADD, OperatorType.OP_EW_SUB, OperatorType.OP_EW_MUL,
+    OperatorType.OP_EW_DIV, OperatorType.OP_EW_MAX, OperatorType.OP_EW_MIN,
+}
+
+
+@dataclasses.dataclass
+class SearchSpace:
+    """Which parallelism families the search may use. The reference's
+    enable_{parameter,attribute}_parallel flags gate only the legacy MCMC
+    space (linear.cc:727,777 get_random_parallel_config /
+    is_valid_parallel_config); the Unity graph search always explores the full
+    space — mirrored here by ``full()`` vs ``from_config()``."""
+
+    parameter: bool = True   # col/row linear, table-sharded embedding
+    attribute: bool = True   # head-parallel attention
+    sequence: bool = True    # ring attention + Q states (TPU-native extension)
+    expert: bool = True      # expert-parallel MoE
+
+    @staticmethod
+    def full() -> "SearchSpace":
+        return SearchSpace()
+
+    @staticmethod
+    def from_config(config) -> "SearchSpace":
+        return SearchSpace(
+            parameter=config.enable_parameter_parallel,
+            attribute=config.enable_attribute_parallel,
+            sequence=getattr(config, "enable_sequence_parallel", True),
+            expert=config.enable_parameter_parallel)
 
 
 @dataclasses.dataclass
@@ -71,6 +104,8 @@ class SearchResult:
     sim_time: float
     sim_memory: int
     mesh_shape: Tuple[int, int]
+    pcg: Optional[PCG] = None          # rewritten graph (xfers applied)
+    states: Optional[Dict[int, str]] = None
 
 
 def factorizations(n: int) -> List[Tuple[int, int]]:
@@ -82,42 +117,94 @@ def factorizations(n: int) -> List[Tuple[int, int]]:
     return out
 
 
-def _tp_valid(node: PCGNode, kind: str, tp: int,
-              in_shapes: List[Tuple[int, ...]]) -> bool:
-    """Divisibility checks (reference: get_valid_machine_views)."""
+def node_options(node: PCGNode, tp: int,
+                 in_shapes: List[Tuple[int, ...]],
+                 space: Optional[SearchSpace] = None
+                 ) -> List[Tuple[str, str, str]]:
+    """Per-op (kind, in_state, out_state) choices — the valid-MachineView
+    enumeration of the reference (get_valid_machine_views, graph.h:230) over
+    the TPU state space. Divisibility checks inline."""
+    space = space or SearchSpace.full()
+    ot = node.op.op_type
     a = node.op.attrs
-    if kind == "none":
-        return True
-    if node.op.op_type == OperatorType.OP_LINEAR:
-        if kind == "col":
-            return a["out_dim"] % tp == 0
-        if kind == "row":
-            return in_shapes[0][-1] % tp == 0
-    if node.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
-        return a["num_heads"] % tp == 0
-    if node.op.op_type == OperatorType.OP_EMBEDDING:
-        return a["num_entries"] % tp == 0
-    if node.op.op_type == OperatorType.OP_CONV2D:
-        return a["out_channels"] % tp == 0
-    return False
+    out = node.out_shapes[0] if node.out_shapes else ()
+
+    def q_ok(shape):  # sequence dim shardable
+        return len(shape) >= 3 and shape[1] % tp == 0
+
+    def s_ok(shape):  # hidden (last) dim shardable
+        return len(shape) >= 2 and shape[-1] % tp == 0
+
+    opts: List[Tuple[str, str, str]] = [("none", "R", "R")]
+    if tp <= 1:
+        return opts
+    if ot == OperatorType.OP_LINEAR:
+        if space.parameter and a["out_dim"] % tp == 0:
+            opts.append(("col", "R", "S"))
+        if space.parameter and in_shapes and in_shapes[0][-1] % tp == 0:
+            opts.append(("row", "S", "R"))
+        if space.sequence and in_shapes and q_ok(in_shapes[0]) and q_ok(out):
+            opts.append(("none", "Q", "Q"))  # dense is per-token
+    elif ot == OperatorType.OP_MULTIHEAD_ATTENTION:
+        if space.attribute and a["num_heads"] % tp == 0:
+            opts.append(("heads", "R", "R"))
+        if space.sequence and in_shapes and q_ok(in_shapes[0]) \
+                and len(node.inputs) == 3 \
+                and len({g for g, _ in node.inputs}) == 1 \
+                and a.get("dropout", 0.0) == 0.0:
+            # self-attention only; the ring kernel has no dropout parameter,
+            # so attention with dropout must keep the einsum core
+            opts.append(("ring", "Q", "Q"))
+    elif ot == OperatorType.OP_EMBEDDING:
+        if space.parameter and a["num_entries"] % tp == 0:
+            opts.append(("table", "R", "R"))
+    elif ot == OperatorType.OP_CONV2D:
+        if space.parameter and a["out_channels"] % tp == 0:
+            opts.append(("col", "R", "S"))
+    elif ot == OperatorType.OP_EXPERTS:
+        if space.expert and a["n"] % tp == 0:
+            opts.append(("expert", "R", "R"))
+    elif ot == OperatorType.OP_LAYERNORM:
+        axes = [x % len(out) for x in a.get("axes", [len(out) - 1])] \
+            if out else []
+        if space.sequence and q_ok(out) and 1 not in axes:
+            opts.append(("none", "Q", "Q"))
+    elif ot == OperatorType.OP_SOFTMAX:
+        axis = a.get("axis", -1) % len(out) if out else -1
+        if space.sequence and q_ok(out) and axis != 1:
+            opts.append(("none", "Q", "Q"))
+    elif ot in _ELEMENTWISE_BINARY:
+        if s_ok(out):
+            opts.append(("none", "S", "S"))
+        if space.sequence and q_ok(out):
+            opts.append(("none", "Q", "Q"))
+    elif ot in _STATE_PRESERVING and len(node.inputs) == 1:
+        if s_ok(out):
+            opts.append(("none", "S", "S"))
+        if space.sequence and q_ok(out):
+            opts.append(("none", "Q", "Q"))
+    return opts
 
 
 def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
-              batch_size: int) -> Tuple[Dict[int, OpSharding],
-                                        Dict[int, str], float]:
-    """Viterbi DP over the topo order: per node, cost table keyed by output
-    state; transitions pay resharding (reference:
-    find_optimal_sequence_graph_time + estimate_xfer_cost). At fan-out/fan-in
-    points the state is pinned to 'R' (the reference's sequence-split
-    bottlenecks are exactly such points).
+              batch_size: int, space: Optional[SearchSpace] = None,
+              lam: float = 1.0
+              ) -> Tuple[Dict[int, OpSharding], Dict[int, str], float]:
+    """Viterbi DP over the topo order: per node, a table keyed by output
+    sharding state; transitions pay resharding collectives (reference:
+    find_optimal_sequence_graph_time + estimate_xfer_cost).
 
-    Note on sequence splits: the reference recursively splits the graph at
-    bottleneck nodes (generic_sequence_optimize, substitution.h:276) because
-    its per-node choice space (all MachineViews) is huge. Here the DP state
-    space is two values, so the per-node table already carries every
-    bottleneck boundary condition exactly — no explicit split is needed.
-    ``PCG.bottlenecks``/``split_at_node`` expose the same machinery for
-    observability and for the substitution engine."""
+    ``lam`` mixes runtime and per-chip memory into the DP objective
+    (reference: the MemoryOptimConfig run_time_cost_factor,
+    memory_optimization.h:24-100): obj = lam * time_ms + (1-lam) * mem_GiB.
+    lam=1.0 is the pure-runtime search.
+
+    Fan-in nodes sum their producers' table costs (shared ancestors are
+    counted once per branch — an over-estimate the final ``simulate`` pass
+    corrects); fan-out states are chosen by the first consumer walked back,
+    other consumers pay conversions. Sink nodes are pinned to state R (the
+    loss consumes replicated logits, reference: final-op label matching
+    model.cc:3090-3124)."""
     from ..ffconst import size_of_datatype
 
     nodes = pcg.compute_nodes()
@@ -125,21 +212,24 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     for n in nodes:
         for g, _ in n.inputs:
             consumers[g] = consumers.get(g, 0) + 1
+    sink_guids = {n.guid for n in pcg.sinks()}
 
-    # dp over (node, out_state) -> (cost, back-pointer (choice, in_state))
+    def mix(time_s: float, mem_bytes: float) -> float:
+        return lam * time_s * 1e3 + (1.0 - lam) * mem_bytes / 2 ** 30
+
     INF = float("inf")
-    table: Dict[int, Dict[str, Tuple[float, Tuple[str, str]]]] = {}
+    # table[guid][state] = (obj, time, mem, (kind, in_state))
+    table: Dict[int, Dict[str, Tuple[float, float, float, Tuple[str, str]]]] \
+        = {}
     for node in nodes:
         in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
-        opts = _TP_OPTIONS.get(node.op.op_type)
-        if opts is None:
-            if node.op.op_type in _STATE_PRESERVING and len(node.inputs) == 1:
-                opts = [("none", "R", "R"), ("none", "S", "S")]
-            else:
-                opts = [("none", "R", "R")]
-        # producer state tables (compute nodes only; sources are state R)
-        def prev_cost(state: str) -> float:
-            total = 0.0
+        opts = node_options(node, tp, in_shapes, space)
+        if node.guid in sink_guids:
+            opts = [o for o in opts if o[2] == "R"] or opts
+
+        def prev_cost(state: str) -> Tuple[float, float, float]:
+            """Sum of producers' best (obj, time, mem) to deliver ``state``."""
+            tot_o = tot_t = tot_m = 0.0
             for g, i in node.inputs:
                 p = pcg.nodes[g]
                 if p.op.op_type in (OperatorType.OP_INPUT,
@@ -148,63 +238,63 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 ptab = table.get(g)
                 if ptab is None:
                     continue
-                if state in ptab and ptab[state][0] < INF:
-                    total += ptab[state][0]
-                else:
-                    # pay an all-gather to convert
-                    other = "S" if state == "R" else "R"
-                    if other not in ptab or ptab[other][0] >= INF:
-                        return INF
-                    nbytes = int(np.prod(p.out_shapes[i])) * \
-                        size_of_datatype(p.op.data_type)
-                    total += ptab[other][0] + sim.resharding_cost(
-                        nbytes, other, state, dp, tp)
-            return total
+                nbytes = int(np.prod(p.out_shapes[i])) * \
+                    size_of_datatype(p.op.data_type)
+                best = None
+                for src_state, (po, pt, pm, _bp) in ptab.items():
+                    if po >= INF:
+                        continue
+                    xfer = sim.resharding_cost(nbytes, src_state, state,
+                                               dp, tp)
+                    cand = (po + mix(xfer, 0.0), pt + xfer, pm)
+                    if best is None or cand[0] < best[0]:
+                        best = cand
+                if best is None:
+                    return (INF, INF, INF)
+                tot_o += best[0]
+                tot_t += best[1]
+                tot_m += best[2]
+            return (tot_o, tot_t, tot_m)
 
-        # multi-consumer producers or multi-input nodes pin states to R
-        multi_in = len([1 for g, _ in node.inputs
-                        if pcg.nodes[g].op.op_type not in
-                        (OperatorType.OP_INPUT, OperatorType.OP_WEIGHT)]) > 1
-
-        tab: Dict[str, Tuple[float, Tuple[str, str]]] = {}
+        tab: Dict[str, Tuple[float, float, float, Tuple[str, str]]] = {}
         for kind, in_state, out_state in opts:
-            if multi_in and in_state != "R":
-                continue
-            if consumers.get(node.guid, 0) > 1 and out_state != "R":
-                continue
             eff_tp = tp if kind != "none" else 1
-            if not _tp_valid(node, kind, tp, in_shapes):
-                continue
-            sh = OpSharding(dp=dp, tp=eff_tp, kind=kind)
+            act_tp = tp if (kind == "none" and out_state in ("S", "Q")) else 1
+            sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp)
             cm = sim.op_cost(node, in_shapes, sh)
-            base = prev_cost(in_state)
-            if base >= INF:
+            base_o, base_t, base_m = prev_cost(in_state)
+            if base_o >= INF:
                 continue
-            c = base + cm.total_time()
-            if out_state not in tab or c < tab[out_state][0]:
-                tab[out_state] = (c, (kind, in_state))
+            node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
+            t = base_t + cm.total_time()
+            mem = base_m + node_mem
+            obj = base_o + mix(cm.total_time(), node_mem)
+            if out_state not in tab or obj < tab[out_state][0]:
+                tab[out_state] = (obj, t, mem, (kind, in_state))
         if not tab:  # fallback: unsharded
             sh = OpSharding(dp=dp, tp=1, kind="none")
             cm = sim.op_cost(node, in_shapes, sh)
-            tab["R"] = (prev_cost("R") + cm.total_time(), ("none", "R"))
+            base_o, base_t, base_m = prev_cost("R")
+            node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
+            tab["R"] = (base_o + mix(cm.total_time(), node_mem),
+                        base_t + cm.total_time(), base_m + node_mem,
+                        ("none", "R"))
         table[node.guid] = tab
 
-    # backtrack: choose best final state, then walk back greedily per node
-    # (the chain DP is exact on chains; at joins states were pinned to R)
+    # backtrack: choose best final state, then walk back per node
     assignment: Dict[int, OpSharding] = {}
     states: Dict[int, str] = {}
-    # choose states from sinks backwards
     chosen: Dict[int, str] = {}
     for node in reversed(nodes):
         tab = table[node.guid]
         if node.guid not in chosen:
-            # unconstrained: pick cheapest state
-            st = min(tab, key=lambda s: tab[s][0])
-            chosen[node.guid] = st
+            chosen[node.guid] = min(tab, key=lambda s: tab[s][0])
         st = chosen[node.guid]
-        kind, in_state = tab[st][1]
+        kind, in_state = tab[st][3]
         eff_tp = tp if kind != "none" else 1
-        assignment[node.guid] = OpSharding(dp=dp, tp=eff_tp, kind=kind)
+        act_tp = tp if (kind == "none" and st in ("S", "Q")) else 1
+        assignment[node.guid] = OpSharding(dp=dp, tp=eff_tp, kind=kind,
+                                           act_tp=act_tp)
         states[node.guid] = st
         for g, _ in node.inputs:
             p = pcg.nodes[g]
@@ -214,11 +304,26 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 ptab = table[g]
                 chosen[g] = in_state if in_state in ptab else \
                     min(ptab, key=lambda s: ptab[s][0])
-    # total time: recompute via simulate so resharding edges are counted once
-    sim_time, _ = sim.simulate(pcg, assignment, states)
+    # total time: recompute via the simulator so resharding edges and shared
+    # subgraphs are counted exactly once (event-driven when the native
+    # task-graph core is available)
+    sim_time = simulate_best(sim, pcg, assignment, states)
     return assignment, states, sim_time
 
 
+def simulate_best(sim: Simulator, pcg: PCG,
+                  assignment: Dict[int, OpSharding],
+                  states: Dict[int, str]) -> float:
+    """Event-driven makespan via the native core (reference:
+    simulate_runtime's per-device timelines); falls back to the additive
+    model when the C++ extension is unavailable."""
+    try:
+        return sim.simulate_event_driven(pcg, assignment, states)
+    except Exception:
+        return sim.simulate(pcg, assignment, states)[0]
+
+
+# ------------------------------------------------------------------ strategies
 def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                            states: Dict[int, str], dp: int, tp: int,
                            data_axis: str = "data",
@@ -233,112 +338,370 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                      data_axis=data_axis)
     view = MachineView(dim=(dp, tp) if tp > 1 else (dp,),
                        stride=(tp, 1) if tp > 1 else (1,))
+
+    def state_spec(state: str, ndim: int):
+        if state == "S" and ndim >= 2:
+            return (data_axis,) + (None,) * (ndim - 2) + (model_axis,)
+        if state == "Q" and ndim >= 3:
+            return (data_axis, model_axis) + (None,) * (ndim - 2)
+        return (data_axis,) + (None,) * (ndim - 1)
+
     for node in pcg.topo_order():
         ns = s.for_node(node.guid)
         ns.view = view
         sh = assignment.get(node.guid)
-        if sh is None or sh.kind == "none" or sh.tp == 1:
+        if sh is None:
+            continue
+        ndim = len(node.out_shapes[0]) if node.out_shapes else 0
+        state = states.get(node.guid, "R")
+        # state-preserving ops keep their sharded state pinned so XLA does
+        # not round-trip through replicated layouts
+        if sh.kind == "none" and state in ("S", "Q") and ndim >= 2 \
+                and tp > 1:
+            ns.output_spec = state_spec(state, ndim)
+            continue
+        if sh.kind == "none" or sh.tp == 1:
             continue
         ot = node.op.op_type
         if ot == OperatorType.OP_LINEAR:
             if sh.kind == "col":
                 ns.weight_specs = {"kernel": (None, model_axis),
                                    "bias": (model_axis,)}
-                ndim = len(node.out_shapes[0])
-                ns.output_spec = (data_axis,) + (None,) * (ndim - 2) + (
-                    model_axis,)
+                ns.output_spec = state_spec("S", ndim)
             elif sh.kind == "row":
                 ns.weight_specs = {"kernel": (model_axis, None),
                                    "bias": (None,)}
-                ndim = len(node.out_shapes[0])
-                ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+                ns.output_spec = state_spec("R", ndim)
         elif ot == OperatorType.OP_MULTIHEAD_ATTENTION:
-            ns.weight_specs = {"wq": (None, model_axis, None),
-                               "wk": (None, model_axis, None),
-                               "wv": (None, model_axis, None),
-                               "wo": (model_axis, None, None),
-                               "bo": (None,)}
-            ndim = len(node.out_shapes[0])
-            ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+            if sh.kind == "heads":
+                ns.weight_specs = {"wq": (None, model_axis, None),
+                                   "wk": (None, model_axis, None),
+                                   "wv": (None, model_axis, None),
+                                   "wo": (model_axis, None, None),
+                                   "bo": (None,)}
+                ns.output_spec = state_spec("R", ndim)
+            elif sh.kind == "ring":
+                ns.extra["sequence_parallel_axis"] = model_axis
+                ns.output_spec = state_spec("Q", ndim)
         elif ot == OperatorType.OP_EMBEDDING:
             ns.weight_specs = {"weight": (model_axis, None)}
-            ndim = len(node.out_shapes[0])
-            ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+            ns.output_spec = state_spec("R", ndim)
         elif ot == OperatorType.OP_CONV2D:
             ns.weight_specs = {"kernel": (None, None, None, model_axis),
                                "bias": (model_axis,)}
+        elif ot == OperatorType.OP_EXPERTS:
+            ns.weight_specs = {"kernel": (model_axis, None, None),
+                               "bias": (model_axis, None)}
+            ns.output_spec = state_spec("R", ndim)
     return s
 
 
+# ----------------------------------------------------------- parallel-op nodes
+_PARALLEL_OP_FOR_TRANSITION = {
+    # (src_state, dst_state) -> (OperatorType, which tensor dim moves)
+    ("S", "R"): (OperatorType.OP_COMBINE, -1),
+    ("Q", "R"): (OperatorType.OP_COMBINE, 1),
+    ("R", "S"): (OperatorType.OP_REPARTITION, -1),
+    ("R", "Q"): (OperatorType.OP_REPARTITION, 1),
+    ("S", "Q"): (OperatorType.OP_ALLTOALL, 1),
+    ("Q", "S"): (OperatorType.OP_ALLTOALL, -1),
+}
+
+
+def insert_parallel_ops(pcg: PCG, assignment: Dict[int, OpSharding],
+                        states: Dict[int, str], strategy: Strategy,
+                        sim: Simulator, dp: int, tp: int) -> int:
+    """Materialize sharding-state transitions as first-class parallel-op
+    nodes (reference: the search output's Repartition/Combine/Replicate/
+    Reduction nodes, src/parallel_ops/). Each inserted node carries the
+    transition's collective cost (visible in the DOT export) and an
+    output_spec constraint that lowers to ``with_sharding_constraint`` —
+    the same data movement, now explicit in the IR. Returns #inserted."""
+    from ..ffconst import size_of_datatype
+    from ..ops.base import op_class_for
+
+    if tp <= 1:
+        return 0
+    model_axis = strategy.axis_names[-1]
+    data_axis = strategy.data_axis
+    inserted = 0
+
+    # 1) Reduction nodes after partial-sum producers (reference: the
+    # Reduction parallel op following a row-parallel Linear,
+    # src/parallel_ops/reduction.cc; for head-parallel attention the wo
+    # projection's contraction over sharded heads is the same pattern)
+    for node in list(pcg.compute_nodes()):
+        sh = assignment.get(node.guid)
+        if sh is None or sh.kind not in ("row", "heads", "table") \
+                or sh.tp <= 1:
+            continue
+        shape = node.out_shapes[0]
+        nbytes = int(np.prod(shape)) * size_of_datatype(node.op.data_type)
+        cost = sim.machine.allreduce_time(nbytes // max(dp, 1), tp)
+        op = op_class_for(OperatorType.OP_REDUCTION)(
+            f"reduction_{node.guid}",
+            {"dim": 0, "degree": tp, "axes": (model_axis,),
+             "comm_cost_us": round(cost * 1e6, 2)},
+            node.op.data_type, num_inputs=1)
+        consumers = [c for c in pcg.consumers(node.guid)]
+        if not consumers:
+            continue
+        new = pcg.insert_node_on_edge(
+            consumers[0],
+            [slot for slot, (g, _i) in
+             enumerate(pcg.nodes[consumers[0]].inputs)
+             if g == node.guid][0], op)
+        for c in consumers[1:]:
+            cn = pcg.nodes[c]
+            cn.inputs = [(new.guid, 0) if g == node.guid else (g, i)
+                         for g, i in cn.inputs]
+        ns = strategy.for_node(new.guid)
+        prod_ns = strategy.node_strategies.get(node.guid)
+        if prod_ns is not None:
+            ns.view = prod_ns.view
+            # the reduced-output constraint belongs to the Reduction node
+            ns.output_spec = prod_ns.output_spec
+            prod_ns.output_spec = None
+        states[new.guid] = states.get(node.guid, "R")
+        assignment[new.guid] = OpSharding(dp=dp, tp=1, kind="none")
+        inserted += 1
+    # group edges by (producer, out_idx, dst_state): one node serves all
+    # consumers needing the same conversion
+    reuse: Dict[Tuple[int, int, str], int] = {}
+    for node in list(pcg.compute_nodes()):
+        if getattr(node.op, "is_parallel_op", False):
+            continue
+        my_state = _in_state_of(node, assignment, states)
+        for slot, (g, i) in enumerate(list(node.inputs)):
+            p = pcg.nodes[g]
+            if p.op.op_type in (OperatorType.OP_INPUT,
+                                OperatorType.OP_WEIGHT):
+                continue
+            src_state = states.get(g, "R")
+            if src_state == my_state:
+                continue
+            key = (g, i, my_state)
+            if key in reuse:
+                node.inputs[slot] = (reuse[key], 0)
+                continue
+            trans = _PARALLEL_OP_FOR_TRANSITION.get((src_state, my_state))
+            if trans is None:
+                continue
+            op_type, dim = trans
+            shape = p.out_shapes[i]
+            nbytes = int(np.prod(shape)) * size_of_datatype(p.op.data_type)
+            cost = sim.resharding_cost(nbytes, src_state, my_state, dp, tp)
+            op = op_class_for(op_type)(
+                f"{op_type.name.lower()}_{g}_{node.guid}",
+                {"dim": dim % len(shape) if shape else 0, "degree": tp,
+                 "axes": (model_axis,),
+                 "comm_cost_us": round(cost * 1e6, 2)},
+                p.op.data_type, num_inputs=1)
+            new = pcg.insert_node_on_edge(node.guid, slot, op)
+            ns = strategy.for_node(new.guid)
+            ns.view = strategy.node_strategies[node.guid].view \
+                if node.guid in strategy.node_strategies else ns.view
+            ndim = len(shape)
+            if my_state == "S" and ndim >= 2:
+                ns.output_spec = (data_axis,) + (None,) * (ndim - 2) + (
+                    model_axis,)
+            elif my_state == "Q" and ndim >= 3:
+                ns.output_spec = (data_axis, model_axis) + (None,) * (ndim - 2)
+            else:
+                ns.output_spec = (data_axis,) + (None,) * (ndim - 1)
+            states[new.guid] = my_state
+            assignment[new.guid] = OpSharding(dp=dp, tp=1, kind="none")
+            reuse[key] = new.guid
+            inserted += 1
+    return inserted
+
+
+def _in_state_of(node: PCGNode, assignment: Dict[int, OpSharding],
+                 states: Dict[int, str]) -> str:
+    """The input state the node's chosen option consumes."""
+    sh = assignment.get(node.guid)
+    st = states.get(node.guid, "R")
+    if sh is None:
+        return "R"
+    if sh.kind in ("col",):
+        return "R"
+    if sh.kind == "row":
+        return "S"
+    if sh.kind == "ring":
+        return "Q"
+    if sh.kind in ("heads", "table", "expert"):
+        return "R"
+    # state-preserving: input state == output state
+    return st
+
+
+# ------------------------------------------------------------ best-first xfers
+def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
+                        batch: int, xfers, budget: int, alpha: float,
+                        space: Optional[SearchSpace] = None,
+                        lam: float = 1.0,
+                        protected_guids: Sequence[int] = ()
+                        ) -> Tuple[PCG, Dict[int, OpSharding],
+                                   Dict[int, str], float]:
+    """The reference's base_optimize (substitution.cc:2229-2306): best-first
+    search over GraphXfer applications, each candidate costed by the DP, with
+    alpha pruning and a budget on explored graphs."""
+    assignment, states, t = dp_assign(pcg, sim, dp, tp, batch, space, lam)
+    best = (pcg, assignment, states, t)
+    if not xfers:
+        return best
+    counter = itertools.count()
+    heap = [(t, next(counter), pcg)]
+    seen: Set[int] = {pcg.hash()}
+    explored = 0
+    while heap and explored < budget:
+        cost, _, g = heapq.heappop(heap)
+        if cost > best[3] * alpha:
+            continue  # prune (reference: substitution.cc:2288)
+        for xfer in xfers:
+            for match in xfer.find_matches(g):
+                if any(guid in protected_guids for guid in match.values()):
+                    continue
+                try:
+                    g2 = xfer.apply(g, match)
+                except Exception:
+                    continue
+                h = g2.hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                explored += 1
+                a2, s2, t2 = dp_assign(g2, sim, dp, tp, batch, space, lam)
+                _log.info("xfer %s: %.3f ms -> %.3f ms", xfer.name,
+                          best[3] * 1e3, t2 * 1e3)
+                if t2 < best[3]:
+                    best = (g2, a2, s2, t2)
+                if t2 < best[3] * alpha:
+                    heapq.heappush(heap, (t2, next(counter), g2))
+                if explored >= budget:
+                    break
+            if explored >= budget:
+                break
+    return best
+
+
+# ------------------------------------------------------------------ top level
 def unity_search(pcg: PCG, config, n_dev: int,
                  machine: Optional[TPUMachineModel] = None,
-                 return_result: bool = False):
+                 return_result: bool = False, calibrate: bool = False,
+                 protected_guids: Sequence[int] = (),
+                 insert_ir_nodes: bool = True,
+                 sim: Optional[Simulator] = None):
     """Top-level search (reference: graph_optimize_task, graph.cc:2047).
 
-    Enumerates mesh factorizations, runs the per-op DP for each, applies
-    alpha pruning, then the memory-λ feasibility loop. Returns a Strategy.
-    """
+    Enumerates mesh factorizations x graph rewrites, runs the {R,S,Q} DP for
+    each, applies alpha pruning, then the memory-λ binary search
+    (graph.cc:2060-2133) when ``--memory-search`` is on. When ``calibrate``
+    the per-op cost model is first grounded by on-device measurement
+    (reference: simulator.cc:489). The best strategy's sharding transitions
+    are materialized as parallel-op IR nodes in ``pcg`` (mutated in place).
+    Returns a Strategy (or the full SearchResult)."""
     if machine is None:
         if config.machine_model_version == 1 and config.machine_model_file:
             machine = TPUMachineModel.from_file(config.machine_model_file,
                                                n_dev)
         else:
             machine = TPUMachineModel.detect(n_dev)
-    sim = Simulator(machine, config.search_overlap_backward_update)
+    if sim is None:
+        sim = Simulator(machine, config.search_overlap_backward_update)
+    if calibrate:
+        n_measured = sim.calibrate_from_pcg(pcg)
+        _log.info("calibrated %d op shapes on device", n_measured)
 
+    xfers = _load_xfers(config)
+    # the Unity graph search explores the full parameter/attribute space like
+    # the reference's (the enable_* flags gate only MCMC, linear.cc:727);
+    # sequence parallelism is a TPU-native extension with its own opt-out
+    space = SearchSpace.full()
+    space.sequence = getattr(config, "enable_sequence_parallel", True)
     batch = config.batch_size
-    best: Optional[SearchResult] = None
     alpha = config.search_alpha
-    budget = config.search_budget if config.search_budget > 0 else 10 ** 9
-    explored = 0
-    with _log.scope("unity_search n_dev=%d" % n_dev):
+    budget = config.search_budget if config.search_budget > 0 else 64
+
+    hbm_budget = machine.hbm_capacity
+    if getattr(config, "device_memory_mb", 0):
+        hbm_budget = config.device_memory_mb * 2 ** 20  # -ll:fsize analog
+
+    def search_all(lam: float, mem_budget: Optional[int] = None
+                   ) -> Optional[SearchResult]:
+        """One sweep over factorizations at a fixed λ. With a memory budget,
+        the best FEASIBLE candidate by time wins (falling back to minimum
+        memory — reference: is_valid_strategy, graph.cc:1984-2032)."""
+        results: List[SearchResult] = []
         for dp, tp in factorizations(n_dev):
             if batch % dp != 0:
                 continue
-            if explored >= budget:
-                break
-            explored += 1
-            assignment, states, t = dp_assign(pcg, sim, dp, tp, batch)
-            _, mem = sim.simulate(pcg, assignment, states)
-            _log.info("mesh dp=%d tp=%d -> %.3f ms, %.1f MiB/chip",
-                      dp, tp, t * 1e3, mem / 2 ** 20)
-            if best is not None and t > best.sim_time * alpha:
-                continue
-            if best is None or t < best.sim_time:
-                best = SearchResult(
-                    strategy=assignment_to_strategy(pcg, assignment, states,
-                                                    dp, tp),
-                    assignment=assignment, sim_time=t, sim_memory=mem,
-                    mesh_shape=(dp, tp))
+            g, a, s, t = best_first_optimize(
+                pcg, sim, dp, tp, batch, xfers, budget=max(budget // 4, 4),
+                alpha=alpha, space=space, lam=lam,
+                protected_guids=protected_guids)
+            _, mem = sim.simulate(g, a, s)
+            _log.info("mesh dp=%d tp=%d lam=%.2f -> %.3f ms, %.1f MiB/chip",
+                      dp, tp, lam, t * 1e3, mem / 2 ** 20)
+            results.append(SearchResult(
+                strategy=assignment_to_strategy(g, a, s, dp, tp),
+                assignment=a, sim_time=t, sim_memory=mem,
+                mesh_shape=(dp, tp), pcg=g, states=s))
+        if not results:
+            return None
+        if mem_budget is not None:
+            feasible = [r for r in results if r.sim_memory <= mem_budget]
+            if feasible:
+                return min(feasible, key=lambda r: r.sim_time)
+            return min(results, key=lambda r: r.sim_memory)
+        return min(results, key=lambda r: r.sim_time)
 
-    # memory-aware λ loop (reference: graph.cc:2060-2133): if the best
-    # strategy exceeds per-chip HBM, penalize memory until one fits
-    if best is not None and config.perform_memory_search and \
-            best.sim_memory > machine.hbm_capacity:
-        feasible = [r for r in _all_results(pcg, sim, n_dev, batch)
-                    if r.sim_memory <= machine.hbm_capacity]
-        if feasible:
-            best = min(feasible, key=lambda r: r.sim_time)
+    with _log.scope("unity_search n_dev=%d" % n_dev):
+        best = search_all(lam=1.0)
+        # memory-aware λ binary search (reference: graph.cc:2060-2133):
+        # find the largest λ (most runtime-weighted) whose best strategy
+        # still fits per-chip HBM
+        if best is not None and config.perform_memory_search and \
+                best.sim_memory > hbm_budget:
+            lo, hi = 0.0, 1.0
+            feasible = None
+            for _ in range(6):
+                mid = (lo + hi) / 2
+                cand = search_all(lam=mid, mem_budget=hbm_budget)
+                if cand is not None and cand.sim_memory <= hbm_budget:
+                    feasible, lo = cand, mid
+                else:
+                    hi = mid
+            if feasible is None:
+                cand = search_all(lam=0.0, mem_budget=hbm_budget)
+                if cand is not None and cand.sim_memory <= hbm_budget:
+                    feasible = cand
+            if feasible is not None:
+                best = feasible
 
     if best is None:
         from ..parallel.strategy import data_parallel_strategy
 
         return data_parallel_strategy(pcg, n_dev)
+
+    # adopt the rewritten graph + materialize transitions as parallel-op nodes
+    if best.pcg is not None and best.pcg is not pcg:
+        pcg.nodes = best.pcg.nodes
+        pcg._order = best.pcg._order
+    if insert_ir_nodes and best.states is not None:
+        dp, tp = best.mesh_shape
+        insert_parallel_ops(pcg, best.assignment, best.states, best.strategy,
+                            sim, dp, tp)
     return (best if return_result else best.strategy)
 
 
-def _all_results(pcg, sim, n_dev, batch):
-    out = []
-    for dp, tp in factorizations(n_dev):
-        if batch % dp != 0:
-            continue
-        assignment, states, t = dp_assign(pcg, sim, dp, tp, batch)
-        _, mem = sim.simulate(pcg, assignment, states)
-        out.append(SearchResult(
-            strategy=assignment_to_strategy(pcg, assignment, states, dp, tp),
-            assignment=assignment, sim_time=t, sim_memory=mem,
-            mesh_shape=(dp, tp)))
-    return out
+def _load_xfers(config):
+    from .substitution import builtin_xfers, load_substitution_json
+
+    xfers = list(builtin_xfers())
+    if config.substitution_json_path:
+        xfers.extend(load_substitution_json(config.substitution_json_path))
+    return xfers
 
 
 # ---------------------------------------------------------------- legacy MCMC
@@ -348,20 +711,22 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
                   seed: int = 0) -> Strategy:
     """Legacy simulated-annealing search over per-op shardings
     (reference: FFModel::mcmc_optimize, model.cc:3285 — random per-op
-    ParallelConfig rewrites accepted by Metropolis criterion)."""
+    ParallelConfig rewrites accepted by Metropolis criterion). Honors
+    enable_parameter_parallel / enable_attribute_parallel exactly like the
+    reference's get_random_parallel_config (linear.cc:727)."""
     machine = machine or TPUMachineModel.detect(n_dev)
     sim = Simulator(machine)
     rng = random.Random(seed)
     batch = config.batch_size
+    space = SearchSpace.from_config(config)
 
     facts = [f for f in factorizations(n_dev) if batch % f[0] == 0]
     dp, tp = facts[0]
     nodes = pcg.compute_nodes()
 
     def random_choice(node):
-        opts = _TP_OPTIONS.get(node.op.op_type, [("none", "R", "R")])
         in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
-        valid = [o for o in opts if _tp_valid(node, o[0], tp, in_shapes)]
+        valid = node_options(node, tp, in_shapes, space)
         return rng.choice(valid or [("none", "R", "R")])
 
     current = {n.guid: OpSharding(dp=dp, tp=tp if k != "none" else 1, kind=k)

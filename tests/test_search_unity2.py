@@ -1,0 +1,236 @@
+"""Widened Unity search: {R,S,Q} states, GraphXfer rewrites, parallel-op IR
+insertion, memory-λ search, MCMC flag gating (VERDICT round-1 items 2/4/6)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.models.bert import BertConfig, build_bert
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import OpSharding, Simulator
+from flexflow_tpu.search.substitution import builtin_xfers
+from flexflow_tpu.search.unity import (SearchSpace, best_first_optimize,
+                                       dp_assign, node_options, unity_search)
+
+
+def _transformer_pcg(batch=8, seq=512, hidden=1024, heads=16, layers=2,
+                     inter=4096):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    cfg = BertConfig(batch_size=batch, seq_len=seq, hidden=hidden,
+                     num_heads=heads, num_layers=layers, intermediate=inter)
+    build_bert(ff, cfg)
+    pcg = ff.create_pcg()
+    return pcg, config, ff
+
+
+def test_search_discovers_megatron_interleave():
+    """A residual transformer at realistic width: the DP must discover the
+    Megatron pattern (col fc1 -> row fc2 and/or head-parallel attention)
+    by itself — VERDICT item 2's Done criterion."""
+    pcg, config, _ = _transformer_pcg(batch=8)
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    assignment, states, t_tp = dp_assign(pcg, sim, dp=2, tp=4, batch_size=8)
+    kinds = {}
+    for g, a in assignment.items():
+        node = pcg.nodes[g]
+        if node.op.op_type == OperatorType.OP_LINEAR:
+            kinds.setdefault(a.kind, 0)
+            kinds[a.kind] += 1
+        if node.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+            kinds.setdefault(f"attn_{a.kind}", 0)
+            kinds[f"attn_{a.kind}"] += 1
+    # fc1 col-parallel + fc2 row-parallel in every block
+    assert kinds.get("col", 0) >= 2 and kinds.get("row", 0) >= 2, kinds
+    # attention head-parallel (attribute parallelism)
+    assert kinds.get("attn_heads", 0) >= 1, kinds
+    # and the hybrid beats pure DP in simulation
+    dp_assignment = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    t_dp, _ = sim.simulate(pcg, dp_assignment)
+    t_hybrid, _ = sim.simulate(pcg, assignment, states)
+    assert t_hybrid < t_dp, (t_hybrid, t_dp)
+
+
+def test_sequence_parallel_in_search_space():
+    """Ring attention (Q states) is a searchable option for self-attention
+    and lowers to the sequence_parallel_axis attr."""
+    pcg, config, _ = _transformer_pcg(batch=8, seq=2048, hidden=256, heads=4,
+                                      layers=1, inter=512)
+    attn = [n for n in pcg.compute_nodes()
+            if n.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION][0]
+    in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in attn.inputs]
+    opts = node_options(attn, 4, in_shapes)
+    assert ("ring", "Q", "Q") in opts
+    # seq-sharded state available on per-token ops
+    lin = [n for n in pcg.compute_nodes()
+           if n.op.op_type == OperatorType.OP_LINEAR][0]
+    lin_shapes = [pcg.nodes[g].out_shapes[i] for g, i in lin.inputs]
+    assert ("none", "Q", "Q") in node_options(lin, 4, lin_shapes)
+    # disabled when the flag says so
+    space = SearchSpace(sequence=False)
+    assert ("ring", "Q", "Q") not in node_options(attn, 4, in_shapes, space)
+
+
+def test_graphxfer_apply_fuses_activation():
+    """GraphXfer.apply performs a real rewrite: dense+relu -> fused dense,
+    graph shrinks, numerics preserved (VERDICT item 2a)."""
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 64))
+    t = ff.dense(x, 32)           # activation NONE
+    t = ff.relu(t)
+    t = ff.dense(t, 8)
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    pcg = ff.create_pcg()
+    n_before = len(pcg.compute_nodes())
+    xfer = [x for x in builtin_xfers() if x.name == "linear_relu_fuse"][0]
+    matches = xfer.find_matches(pcg)
+    assert len(matches) == 1
+    g2 = xfer.apply(pcg, matches[0])
+    assert len(g2.compute_nodes()) == n_before - 1
+    fused = [n for n in g2.compute_nodes()
+             if n.op.op_type == OperatorType.OP_LINEAR
+             and n.op.attrs.get("activation") == ActiMode.AC_MODE_RELU]
+    assert len(fused) == 1
+    # numerics: run both graphs with identical weights
+    from flexflow_tpu.execution.executor import Executor
+
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import OpContext
+
+    rng = np.random.default_rng(0)
+    xval = jnp.asarray(rng.normal(size=(4, 64)).astype(np.float32))
+    kernel1 = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    bias1 = jnp.zeros(32)
+
+    def run(pcg_in):
+        vals = {}
+        ctx = OpContext(training=False)
+        for node in pcg_in.topo_order():
+            if node.op.op_type == OperatorType.OP_INPUT:
+                vals[node.guid] = [xval]
+                continue
+            ins = [vals[g][i] for g, i in node.inputs]
+            if node.op.op_type == OperatorType.OP_LINEAR \
+                    and node.op.attrs["out_dim"] == 32:
+                params = {"kernel": kernel1, "bias": bias1}
+            else:
+                ws = node.op.weight_specs([x.shape for x in ins])
+                params = {w: jnp.ones(spec[0]) * 0.01
+                          for w, spec in ws.items()}
+            vals[node.guid] = node.op.forward(params, ins, ctx)
+        sink = [n for n in pcg_in.compute_nodes()][-1]
+        return np.asarray(vals[sink.guid][0])
+
+    np.testing.assert_allclose(run(pcg), run(g2), rtol=1e-5)
+
+
+def test_best_first_applies_beneficial_xfer():
+    """best_first_optimize adopts the fused graph when the simulator says it
+    is cheaper (reference: base_optimize's accept-if-better)."""
+    config = FFConfig()
+    config.batch_size = 64
+    ff = FFModel(config)
+    x = ff.create_tensor((64, 1024))
+    t = ff.dense(x, 4096)
+    t = ff.relu(t)
+    t = ff.dense(t, 1024)
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    g, a, s, t_best = best_first_optimize(
+        pcg, sim, dp=8, tp=1, batch=64, xfers=builtin_xfers(), budget=16,
+        alpha=1.05)
+    assert len(g.compute_nodes()) < len(pcg.compute_nodes())
+    _, _, t_orig = dp_assign(pcg, sim, 8, 1, 64)
+    assert t_best <= t_orig
+
+
+def test_unity_search_inserts_parallel_op_nodes():
+    """The searched strategy's sharding transitions appear as first-class
+    parallel-op nodes with costs in the DOT export (VERDICT item 6)."""
+    pcg, config, _ = _transformer_pcg(batch=8)
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    res = unity_search(pcg, config, 8, machine=machine, return_result=True)
+    if res.mesh_shape[1] == 1:
+        pytest.skip("search picked pure DP; no transitions to materialize")
+    par_nodes = [n for n in pcg.compute_nodes()
+                 if getattr(n.op, "is_parallel_op", False)]
+    assert par_nodes, "no parallel-op nodes inserted"
+    dot = pcg.to_dot()
+    assert any(n.name in dot for n in par_nodes)
+    assert all("comm_cost_us" in n.op.attrs for n in par_nodes)
+
+
+def test_memory_lambda_search_returns_feasible():
+    """Unconstrained best exceeds a small HBM budget; the λ binary search
+    must return a feasible (slower, smaller) strategy instead (reference:
+    graph.cc:2060-2133, --memory-search + -ll:fsize). Activation-heavy MLP:
+    the time-optimal mesh (dp=4,tp=2 at ~40 MiB/chip) is infeasible at a
+    25 MiB budget while higher-TP strategies fit."""
+    config = FFConfig()
+    config.batch_size = 2048
+    ff = FFModel(config)
+    x = ff.create_tensor((2048, 1024))
+    t = x
+    for _ in range(4):
+        t = ff.dense(t, 1024, ActiMode.AC_MODE_RELU)
+    ff.softmax(ff.dense(t, 8))
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+
+    config.perform_memory_search = False
+    res_free = unity_search(pcg.copy(), config, 8, machine=machine,
+                            return_result=True)
+    budget_mb = 25
+    assert res_free.sim_memory > budget_mb * 2 ** 20, \
+        "wedge vanished: unconstrained best already fits"
+    config.device_memory_mb = budget_mb
+    config.perform_memory_search = True
+    res_mem = unity_search(pcg.copy(), config, 8, machine=machine,
+                           return_result=True)
+    assert res_mem.sim_memory <= budget_mb * 2 ** 20, \
+        f"λ search returned infeasible {res_mem.sim_memory / 2 ** 20:.1f} MiB"
+    assert res_mem.sim_time >= res_free.sim_time  # paid time for memory
+
+
+def test_mcmc_honors_parallel_flags():
+    """enable_parameter_parallel gates the MCMC space exactly like the
+    reference (linear.cc:727 get_random_parallel_config)."""
+    pcg, config, _ = _transformer_pcg(batch=8, seq=64, hidden=128, heads=4,
+                                      layers=1, inter=256)
+    node = [n for n in pcg.compute_nodes()
+            if n.op.op_type == OperatorType.OP_LINEAR][0]
+    in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+    space_off = SearchSpace.from_config(config)  # defaults: both False
+    kinds_off = {k for k, _, _ in node_options(node, 4, in_shapes, space_off)}
+    assert "col" not in kinds_off and "row" not in kinds_off
+    config.enable_parameter_parallel = True
+    space_on = SearchSpace.from_config(config)
+    kinds_on = {k for k, _, _ in node_options(node, 4, in_shapes, space_on)}
+    assert "col" in kinds_on and "row" in kinds_on
+
+
+def test_searched_strategy_with_parallel_ops_executes():
+    """End-to-end: a search-produced strategy (with inserted parallel-op
+    nodes) trains on the 8-device CPU mesh."""
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    cfg = BertConfig(batch_size=8, seq_len=128, hidden=512, num_heads=8,
+                     num_layers=1, intermediate=2048)
+    build_bert(ff, cfg)
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy_fn=lambda pcg: unity_search(pcg, config, 8,
+                                                    machine=machine))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, cfg.seq_len, cfg.hidden)).astype(np.float32)
+    y = rng.integers(0, 2, size=16).astype(np.int32)
+    ff.fit(x, y, epochs=1)
