@@ -179,6 +179,24 @@ def cost_model_checks(ff, config, measured_step_s: float) -> dict:
         t_dp = simulate_best(sim8, pcg, dp8, {})
         out["searched_vs_dp_8chip_sim"] = round(t_dp / res.sim_time, 3)
         out["searched_mesh"] = list(res.mesh_shape)
+
+        # DLRM leg of the OSDI'22 artifact (scripts/osdi22ae/dlrm.sh):
+        # embedding-table parallelism is the searched win there
+        from flexflow_tpu import FFConfig, FFModel
+        from flexflow_tpu.models.dlrm import build_dlrm
+
+        dconfig = FFConfig()
+        dconfig.batch_size = 64
+        dff = FFModel(dconfig)
+        build_dlrm(dff, batch_size=64,
+                   embedding_sizes=(200000,) * 8, embedding_dim=64)
+        dpcg = dff.create_pcg()
+        dres = unity_search(dpcg.copy(), dconfig, 8, machine=machine8,
+                            return_result=True, insert_ir_nodes=False)
+        ddp = {n.guid: OpSharding(dp=8) for n in dpcg.compute_nodes()}
+        dsim = Simulator(machine8)
+        t_ddp = simulate_best(dsim, dpcg, ddp, {})
+        out["dlrm_searched_vs_dp_8chip_sim"] = round(t_ddp / dres.sim_time, 3)
     except Exception as e:  # cost-model check must never sink the bench
         out["cost_model_check_error"] = f"{type(e).__name__}: {e}"[:200]
     return out

@@ -1,5 +1,6 @@
-"""Keras-frontend CIFAR-10 CNN (reference: examples/python/keras/
-seq_cifar10_cnn.py)."""
+"""Keras-frontend CIFAR-10 CNN with the cifar10 dataset loader and a
+verification callback (reference: examples/python/keras/seq_cifar10_cnn.py —
+cifar10.load_data + VerifyMetrics)."""
 import os
 import sys
 
@@ -11,9 +12,10 @@ import numpy as np  # noqa: E402
 from flexflow_tpu.frontends.keras import (Activation, Conv2D, Dense,  # noqa: E402
                                           Flatten, Input, MaxPooling2D,
                                           Sequential)
+from flexflow_tpu.frontends.keras import callbacks, datasets  # noqa: E402
 
 
-def main(argv=None):
+def main(argv=None, num_samples=512):
     model = Sequential([
         Input(shape=(3, 32, 32)),
         Conv2D(32, (3, 3), padding="same", activation="relu"),
@@ -31,11 +33,12 @@ def main(argv=None):
         model.ffconfig.parse_args(argv)
     model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
                   metrics=("accuracy",))
-    bs = model.ffconfig.batch_size
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(bs * 2, 3, 32, 32)).astype(np.float32)
-    y = rng.integers(0, 10, size=(bs * 2,)).astype(np.int32)
-    perf = model.fit(x, y, epochs=model.ffconfig.epochs)
+    (x_train, y_train), _ = datasets.cifar10.load_data()
+    x = (x_train.astype("float32") / 255)[:num_samples]
+    y = y_train.astype("int32").reshape(-1, 1)[:num_samples]
+    n = (len(x) // model.ffconfig.batch_size) * model.ffconfig.batch_size
+    perf = model.fit(x[:n], y[:n], epochs=model.ffconfig.epochs,
+                     callbacks=[callbacks.VerifyMetrics(0.0)])
     print(f"train accuracy = {perf.accuracy():.4f}")
     return model, perf
 

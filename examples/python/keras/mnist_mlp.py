@@ -1,4 +1,6 @@
-"""Keras-frontend MNIST MLP (reference: examples/python/keras/seq_mnist_mlp.py)."""
+"""Keras-frontend MNIST MLP with the mnist dataset loader and callbacks
+(reference: examples/python/keras/seq_mnist_mlp.py — mnist.load_data,
+VerifyMetrics/EpochVerifyMetrics, LR scheduling via callbacks.py)."""
 import os
 import sys
 
@@ -7,15 +9,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import numpy as np  # noqa: E402
 
-from flexflow_tpu.frontends.keras import (Activation, Dense, Input,  # noqa: E402
-                                          Sequential)
+from flexflow_tpu.frontends.keras import (Activation, Dense, Dropout,  # noqa: E402
+                                          Input, Sequential)
+from flexflow_tpu.frontends.keras import callbacks, datasets  # noqa: E402
 
 
-def main(argv=None):
+def main(argv=None, num_samples=4096):
+    (x_train, y_train), _ = datasets.mnist.load_data()
+    x_train = (x_train.reshape(-1, 784).astype("float32") / 255)[:num_samples]
+    y_train = np.reshape(y_train.astype("int32"),
+                         (len(y_train), 1))[:num_samples]
+
     model = Sequential([
         Input(shape=(784,)),
         Dense(512, activation="relu"),
+        Dropout(0.2),
         Dense(512, activation="relu"),
+        Dropout(0.2),
         Dense(10),
         Activation("softmax"),
     ])
@@ -23,12 +33,12 @@ def main(argv=None):
         model.ffconfig.parse_args(argv)
     model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
                   metrics=("accuracy",))
-
-    bs = model.ffconfig.batch_size
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(bs * 4, 784)).astype(np.float32)
-    y = rng.integers(0, 10, size=(bs * 4,)).astype(np.int32)
-    perf = model.fit(x, y, epochs=model.ffconfig.epochs)
+    n = (len(x_train) // model.ffconfig.batch_size) * \
+        model.ffconfig.batch_size
+    cbs = [callbacks.LearningRateScheduler(lambda e: 0.01 * 0.9 ** e),
+           callbacks.VerifyMetrics(0.0)]
+    perf = model.fit(x_train[:n], y_train[:n],
+                     epochs=model.ffconfig.epochs, callbacks=cbs)
     print(f"train accuracy = {perf.accuracy():.4f}")
     return model, perf
 

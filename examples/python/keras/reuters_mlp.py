@@ -1,0 +1,55 @@
+"""Keras-frontend Reuters topic-classification MLP with dataset loader,
+Tokenizer preprocessing and callbacks (reference:
+examples/python/keras/seq_reuters_mlp.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Activation, Dense,  # noqa: E402
+                                          Input, Sequential)
+from flexflow_tpu.frontends.keras import callbacks  # noqa: E402
+from flexflow_tpu.frontends.keras import datasets  # noqa: E402
+from flexflow_tpu.frontends.keras import preprocessing  # noqa: E402
+
+
+def main(argv=None, max_words=1000, epochs=5):
+    print("Loading data...")
+    (x_train, y_train), (x_test, y_test) = datasets.reuters.load_data(
+        num_words=max_words, test_split=0.2)
+    print(len(x_train), "train sequences")
+    num_classes = int(np.max(y_train)) + 1
+    print(num_classes, "classes")
+
+    print("Vectorizing sequence data...")
+    tokenizer = preprocessing.text.Tokenizer(num_words=max_words)
+    x_train = tokenizer.sequences_to_matrix(x_train, mode="binary")
+    x_train = x_train.astype("float32")
+    y_train = np.reshape(y_train.astype("int32"), (len(y_train), 1))
+
+    model = Sequential([
+        Input(shape=(max_words,)),
+        Dense(512, activation="relu"),
+        Dense(num_classes),
+        Activation("softmax"),
+    ])
+    if argv:
+        model.ffconfig.parse_args(argv)
+    n = (len(x_train) // model.ffconfig.batch_size) * \
+        model.ffconfig.batch_size
+    model.compile(optimizer={"class_name": "Adam",
+                             "config": {"learning_rate": 0.01}},
+                  loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    perf = model.fit(x_train[:n], y_train[:n], epochs=epochs,
+                     callbacks=[callbacks.VerifyMetrics(0.0)])
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return model, perf
+
+
+if __name__ == "__main__":
+    print("Sequential model, reuters mlp")
+    main(sys.argv[1:])

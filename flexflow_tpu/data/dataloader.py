@@ -83,24 +83,48 @@ def device_put_batch(arrays: List[np.ndarray], shardings: List[Any]):
 
 
 def prefetch_iterator(it: Iterator, shardings: List[Any], depth: int = 2):
-    """Background-thread prefetch of device batches (double buffering)."""
+    """Background-thread prefetch of device batches (double buffering).
+
+    Abandoning the generator early (e.g. fit breaking out on a dynamic
+    recompile) stops the producer promptly — without the stop flag it would
+    stay blocked on ``q.put`` for the rest of the process, pinning its
+    in-flight device batches."""
+    from queue import Empty, Full
+
     q: Queue = Queue(maxsize=depth)
+    stop = threading.Event()
     _END = object()
 
     def producer():
         try:
             for batch in it:
-                q.put(device_put_batch(batch, shardings))
+                staged = device_put_batch(batch, shardings)
+                while not stop.is_set():
+                    try:
+                        q.put(staged, timeout=0.1)
+                        break
+                    except Full:
+                        continue
+                if stop.is_set():
+                    return
             q.put(_END)
         except BaseException as e:  # propagate to the consumer, don't swallow
             q.put(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            break
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a producer waiting on a full queue
+            while True:
+                q.get_nowait()
+        except Empty:
+            pass

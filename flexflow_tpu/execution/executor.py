@@ -43,6 +43,10 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._forward_jit = None
+        # cache-op state (reference: src/ops/cache.cc — cached intermediate
+        # tensors across iterations, host-scored, paired with recompile)
+        self.cache_nodes = [n for n in pcg.compute_nodes()
+                            if n.op.op_type == OperatorType.OP_CACHE]
 
         # apply strategy op-attr overrides (e.g. ring-attention seq axis)
         for guid, ns in strategy.node_strategies.items():
@@ -189,7 +193,8 @@ class Executor:
                 rng=(jax.random.fold_in(ctx.rng, node.guid)
                      if ctx.rng is not None else None),
                 seq_length=ctx.seq_length, mesh=ctx.mesh,
-                profiling=ctx.profiling, aux_losses=ctx.aux_losses)
+                profiling=ctx.profiling, aux_losses=ctx.aux_losses,
+                cache_in=ctx.cache_in, cache_out=ctx.cache_out)
             outs = op.forward(node_params, inputs, node_ctx)
             # apply the strategy's output sharding constraint (parallel ops and
             # any node the search pinned)
@@ -207,11 +212,29 @@ class Executor:
             f"model has {len(input_nodes)} inputs, got {len(xs)}"
         return {n.guid: x for n, x in zip(input_nodes, xs)}
 
+    # ----------------------------------------------------------- cache state
+    def init_cache(self):
+        """Zeroed cache-state pytree for the graph's CacheOps:
+        {"__use_cache__": False, op_name: zeros(input shape)}."""
+        import jax.numpy as jnp
+
+        cache = {"__use_cache__": jnp.asarray(False)}
+        for node in self.cache_nodes:
+            g, i = node.inputs[0]
+            src = self.pcg.nodes[g]
+            cache[node.name] = jnp.zeros(
+                src.out_shapes[i], dtype_to_jnp(src.out_dtypes[i]))
+        return cache
+
     # --------------------------------------------------------------- train step
     def make_train_step(self):
         """One fused jitted step: forward + loss + grad + metrics + update
         (SURVEY §7 hard-part 6 — the reference's separate
-        zero_gradients/forward/backward/update phases collapse into this)."""
+        zero_gradients/forward/backward/update phases collapse into this).
+
+        With CacheOps in the graph the step takes the cache pytree as an
+        extra trailing argument and returns the fresh cache values as an
+        extra trailing result (reference: cache.cc's update/score tasks)."""
         import jax
 
         if self._train_step is not None:
@@ -219,23 +242,28 @@ class Executor:
 
         mesh = self.mesh
         opt = self.optimizer
+        has_cache = bool(self.cache_nodes)
 
-        def loss_fn(params, xs, labels, rng):
+        def loss_fn(params, xs, labels, rng, cache):
             params_c, xs = self._cast_for_compute(params, xs)
-            ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[])
+            cache_out = {}
+            ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[],
+                            cache_in=cache, cache_out=cache_out)
             values = self.forward_outputs(params_c, self._bind_inputs(xs), ctx)
             logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
             loss = loss_value(self.loss_type, logits, labels,
                               self.repl_labels)
             for aux in ctx.aux_losses:
                 loss = loss + aux
-            return loss, logits
+            return loss, (logits, cache_out)
 
-        def step(params, opt_state, xs, labels, rng):
-            (loss, logits), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, xs, labels, rng)
+        def step(params, opt_state, xs, labels, rng, cache=None):
+            (loss, (logits, cache_out)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, xs, labels, rng, cache)
             new_params, new_state = opt.update(params, grads, opt_state)
             m = self._compute_metrics(logits, labels)
+            if has_cache:
+                return new_params, new_state, loss, m, cache_out
             return new_params, new_state, loss, m
 
         jit_kwargs = {"donate_argnums": (0, 1)}

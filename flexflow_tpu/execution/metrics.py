@@ -36,6 +36,11 @@ class PerfMetrics:
     def accuracy(self) -> float:
         return self.train_correct / max(self.train_all, 1)
 
+    def get_accuracy(self) -> float:
+        """reference name (flexflow_cffi.py PerfMetrics.get_accuracy —
+        returns percent)."""
+        return self.accuracy() * 100.0
+
     def mean(self, field: str) -> float:
         return getattr(self, field) / max(self.train_all, 1)
 

@@ -25,6 +25,17 @@ class Optimizer:
     def update(self, params, grads, state):
         raise NotImplementedError
 
+    def set_learning_rate(self, lr: float) -> None:
+        """reference: optimizer.h set_learning_rate (used by the Keras
+        LearningRateScheduler callback). The jitted train step bakes the
+        rate in as a constant, so callers must rebuild it — the keras fit
+        loop watches ``_lr_changed``."""
+        if hasattr(self, "lr"):
+            self.lr = float(lr)
+        else:
+            self.alpha = float(lr)
+        self._lr_changed = True
+
 
 class SGDOptimizer(Optimizer):
     """reference: optimizer.h:36-60 (lr, momentum, nesterov, weight_decay)."""

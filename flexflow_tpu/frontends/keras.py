@@ -265,9 +265,34 @@ class _BaseModel:
 
     def fit(self, x, y, batch_size: Optional[int] = None,
             epochs: int = 1, callbacks=None, **kw):
-        """reference: base_model.py:198."""
+        """reference: base_model.py:198 — drives FFModel.fit one epoch at a
+        time so epoch-level callbacks (callbacks.py) fire exactly like the
+        reference's loop; EpochVerifyMetrics-style callbacks early-stop by
+        returning True from on_epoch_end."""
         assert self.ffmodel is not None, "compile the model first"
-        return self.ffmodel.fit(x, y, batch_size=batch_size, epochs=epochs)
+        callbacks = list(callbacks or [])
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.on_train_begin()
+        perf = None
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            opt = self.ffmodel.optimizer
+            if getattr(opt, "_lr_changed", False):
+                # jitted step baked the old rate in as a constant; rebuild
+                self.ffmodel.executor._train_step = None
+                opt._lr_changed = False
+            perf = self.ffmodel.fit(x, y, batch_size=batch_size, epochs=1)
+            stop = False
+            for cb in callbacks:
+                if cb.on_epoch_end(epoch):
+                    stop = True
+            if stop:
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        return perf
 
     def evaluate(self, x, y, batch_size: Optional[int] = None):
         return self.ffmodel.eval(x, y, batch_size=batch_size)
@@ -326,3 +351,12 @@ class Model(_BaseModel):
 
         for out in self.outputs:
             build_node(out)
+
+
+# -- reference-parity submodules (python/flexflow/keras/{callbacks,datasets,
+# preprocessing}) exposed under the frontend namespace -------------------------
+from . import keras_callbacks as callbacks  # noqa: E402
+from . import keras_datasets as datasets  # noqa: E402
+from . import keras_preprocessing as preprocessing  # noqa: E402
+from .keras_callbacks import (Callback, EpochVerifyMetrics,  # noqa: E402
+                              LearningRateScheduler, VerifyMetrics)

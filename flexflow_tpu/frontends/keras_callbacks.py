@@ -1,0 +1,97 @@
+"""Keras-frontend callbacks (reference: python/flexflow/keras/callbacks.py).
+
+Same surface: ``Callback`` base with the six hooks, ``LearningRateScheduler``
+(epoch-indexed schedule driving optimizer.set_learning_rate),
+``VerifyMetrics`` (asserts final accuracy) and ``EpochVerifyMetrics``
+(per-epoch accuracy check with early stop). Callbacks are invoked by the
+keras models' ``fit`` (models drive FFModel.fit one epoch at a time so the
+epoch hooks fire exactly like the reference's base_model.py loop).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Callback:
+    """reference: callbacks.py Callback."""
+
+    def __init__(self):
+        self.validation_data = None
+        self.model = None
+        self.params = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """reference: callbacks.py LearningRateScheduler — calls
+    ``optimizer.set_learning_rate(schedule(epoch))`` each epoch begin."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        optimizer = self.model.ffmodel.optimizer
+        if not hasattr(optimizer, "lr") and not hasattr(optimizer, "alpha"):
+            raise ValueError('Optimizer must have a "lr" attribute.')
+        lr = self.schedule(epoch)
+        if not isinstance(lr, (float, np.float32, np.float64)):
+            raise ValueError('The output of the "schedule" function '
+                             'should be float.')
+        optimizer.set_learning_rate(lr)
+        print("set learning rate ", lr)
+
+
+class VerifyMetrics(Callback):
+    """reference: callbacks.py VerifyMetrics — asserts accuracy at train
+    end. Accepts a float or an enum-like object with ``.value``."""
+
+    def __init__(self, accuracy):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+
+    def on_train_end(self, logs=None):
+        perf = self.model.ffmodel.get_perf_metrics()
+        accuracy = perf.get_accuracy()
+        if accuracy < self.accuracy:
+            assert 0, "Accuracy is wrong"
+
+
+class EpochVerifyMetrics(Callback):
+    """reference: callbacks.py EpochVerifyMetrics — early-stops once the
+    per-epoch accuracy passes the bar."""
+
+    def __init__(self, accuracy, early_stop=True):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+        self.early_stop = early_stop
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        perf = self.model.ffmodel.get_perf_metrics()
+        accuracy = perf.get_accuracy()
+        if not self.early_stop:
+            return False
+        return accuracy > self.accuracy

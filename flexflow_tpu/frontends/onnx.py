@@ -102,6 +102,57 @@ class ONNXModel:
             elif op == "Concat":
                 t = ffmodel.concat([i for i in ins if i is not None],
                                    axis=attr(node, "axis", 1))
+            elif op == "Split":
+                # reference: handleSplit (model.py:103) — sizes from the
+                # `split` attr (opset <13), the second input initializer
+                # (opset >=13), or an even split
+                sizes = attr(node, "split")
+                if sizes is None and len(node.input) > 1 \
+                        and node.input[1] in self.initializers:
+                    sizes = self.initializers[node.input[1]].tolist()
+                axis = attr(node, "axis", 0)
+                if sizes is None:
+                    n_out = len(node.output)
+                    dim = ins[0].dims[axis]
+                    sizes = [dim // n_out] * n_out
+                outs = ffmodel.split(ins[0], sizes, axis=axis)
+                for name_i, t_i in zip(node.output, outs):
+                    env[name_i] = t_i
+                continue
+            elif op == "GlobalAveragePool":
+                # reference: handleGlobalAveragePool (model.py:137) —
+                # pool over the full spatial extent
+                h, w = ins[0].dims[2], ins[0].dims[3]
+                t = ffmodel.pool2d(ins[0], h, w, 1, 1, 0, 0,
+                                   PoolType.POOL_AVG)
+            elif op == "BatchNormalization":
+                t = ffmodel.batch_norm(ins[0], relu=False)
+            elif op == "Pad":
+                # reference: handlePad (model.py:229) — treated as identity
+                # (FlexFlow pads inside conv/pool)
+                t = ins[0]
+            elif op == "Unsqueeze":
+                axes = attr(node, "axes")
+                if axes is None and len(node.input) > 1:
+                    axes = self.initializers[node.input[1]].tolist()
+                shape = list(ins[0].dims)
+                for a in sorted(axes or []):
+                    shape.insert(a if a >= 0 else len(shape) + a + 1, 1)
+                t = ffmodel.reshape(ins[0], shape)
+            elif op == "Constant":
+                val = None
+                for a in node.attribute:
+                    if a.name == "value":
+                        val = numpy_helper.to_array(a.t)
+                env[node.output[0]] = val
+                continue
+            elif op == "Range":
+                # reference: handleRange (model.py:279) — eager host value
+                start = env.get(node.input[0], 0)
+                limit = env.get(node.input[1])
+                delta = env.get(node.input[2], 1)
+                env[node.output[0]] = np.arange(start, limit, delta)
+                continue
             elif op == "Flatten":
                 t = ffmodel.flat(ins[0])
             elif op == "Reshape":

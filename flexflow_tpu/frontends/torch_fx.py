@@ -80,6 +80,204 @@ class PyTorchModel:
     def apply(self, ffmodel: FFModel, input_tensors: List[Tensor]):
         return self.torch_to_ff(ffmodel, input_tensors)
 
+    # ---- .ff model file format (reference: torch/model.py torch_to_string
+    # :2597 / file_to_ff :2540 — "name; in,; out,; OPTYPE; params..." lines,
+    # IR_DELIMITER "; ", INOUT_NODE_DELIMITER ",") ------------------------
+    def torch_to_string(self) -> List[str]:
+        """Serialize the traced graph to .ff IR lines (reference:
+        PyTorchModel.torch_to_string). Field orders per node type match the
+        reference's parse() implementations so files interchange."""
+        import torch.fx as fx
+
+        traced = fx.symbolic_trace(self.module)
+        modules = dict(traced.named_modules())
+        lines = []
+        for node in traced.graph.nodes:
+            lines.append(_node_to_ir(node, modules))
+        return [ln for ln in lines if ln is not None]
+
+    def torch_to_file(self, filename: str) -> None:
+        """reference: torch/model.py:2597."""
+        with open(filename, "w") as f:
+            for line in self.torch_to_string():
+                f.write(line + "\n")
+
+    @staticmethod
+    def file_to_ff(filename: str, ffmodel: FFModel,
+                   input_tensors: List[Tensor]):
+        """Rebuild an FFModel graph from a .ff file (reference:
+        torch/model.py:2540 — per-line dispatch on the OPTYPE field)."""
+        with open(filename) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        node_to_output: Dict[str, Any] = {}
+        output_tensors: List[Tensor] = []
+        input_index = 0
+        for line in lines:
+            items = [s.strip() for s in line.split(";")]
+            name, in_s, _out_s, op = items[0], items[1], items[2], items[3]
+            innodes = [s for s in in_s.split(",") if s.strip()]
+            ins = [node_to_output[n] for n in innodes]
+            if op == "INPUT":
+                node_to_output[name] = input_tensors[input_index]
+                input_index += 1
+                continue
+            if op == "OUTPUT":
+                output_tensors.extend(ins)
+                continue
+            node_to_output[name] = _ir_to_op(ffmodel, op, name, ins, items)
+        return output_tensors
+
+
+_IR_DELIM = "; "
+
+
+def _io_str(names) -> str:
+    return ",".join(names) + "," if names else ""
+
+
+def _node_to_ir(node, modules) -> Optional[str]:
+    """One fx node -> one .ff line (field orders: reference torch/model.py
+    LinearNode.parse :253, Conv2dNode :301, Pool2dNode :372, EmbeddingNode,
+    DropoutMNode, ConcatNode, module activations)."""
+    import operator
+
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    ins = [a.name for a in node.args
+           if hasattr(a, "name") and not isinstance(a, str)] \
+        if node.op != "output" else None
+    outs = [u.name for u in node.users]
+
+    def line(op: str, *params) -> str:
+        return _IR_DELIM.join([node.name, _io_str(ins), _io_str(outs), op]
+                              + [str(p) for p in params])
+
+    if node.op == "placeholder":
+        return line("INPUT")
+    if node.op == "output":
+        args = node.args[0]
+        args = args if isinstance(args, (tuple, list)) else (args,)
+        ins = [a.name for a in args if hasattr(a, "name")]
+        return _IR_DELIM.join([node.name, _io_str(ins), "", "OUTPUT"])
+    if node.op == "call_module":
+        mod = modules[node.target]
+        if isinstance(mod, nn.Linear):
+            return line("LINEAR", mod.out_features,
+                        ActiMode.AC_MODE_NONE.value,
+                        int(mod.bias is not None))
+        if isinstance(mod, nn.Conv2d):
+            return line("CONV2D", mod.out_channels, mod.kernel_size[0],
+                        mod.kernel_size[1], mod.stride[0], mod.stride[1],
+                        mod.padding[0], mod.padding[1],
+                        ActiMode.AC_MODE_NONE.value, mod.groups,
+                        int(mod.bias is not None))
+        if isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+            k = mod.kernel_size if isinstance(mod.kernel_size, int) \
+                else mod.kernel_size[0]
+            st = mod.stride if isinstance(mod.stride, int) else \
+                (mod.stride[0] if mod.stride else k)
+            p = mod.padding if isinstance(mod.padding, int) \
+                else mod.padding[0]
+            pt = PoolType.POOL_MAX if isinstance(mod, nn.MaxPool2d) \
+                else PoolType.POOL_AVG
+            return line("POOL2D", k, st, p, pt.value,
+                        ActiMode.AC_MODE_NONE.value)
+        if isinstance(mod, nn.BatchNorm2d):
+            return line("BATCH_NORM")
+        if isinstance(mod, nn.LayerNorm):
+            return line("LAYER_NORM")
+        if isinstance(mod, nn.Embedding):
+            return line("EMBEDDING", mod.num_embeddings, mod.embedding_dim)
+        if isinstance(mod, nn.Dropout):
+            return line("DROPOUT", mod.p)
+        if isinstance(mod, nn.Flatten):
+            return line("FLAT")
+        simple = {nn.ReLU: "RELU", nn.Sigmoid: "SIGMOID", nn.Tanh: "TANH",
+                  nn.GELU: "GELU", nn.Identity: "IDENTITY",
+                  nn.Softmax: "SOFTMAX"}
+        for cls, opname in simple.items():
+            if isinstance(mod, cls):
+                return line(opname)
+        raise NotImplementedError(
+            f".ff export: module {type(mod).__name__}")
+    if node.op in ("call_function", "call_method"):
+        t = node.target
+        if t in (operator.add, torch.add):
+            return line("ADD")
+        if t in (operator.mul, torch.mul):
+            return line("MULTIPLY")
+        if t is torch.cat:
+            tensors = node.args[0]
+            ins = [a.name for a in tensors]
+            axis = node.kwargs.get("dim", node.args[1]
+                                   if len(node.args) > 1 else 0)
+            return line("CONCAT", axis)
+        if t is torch.flatten or t == "flatten":
+            return line("FLAT")
+        if t in (F.relu, torch.relu) or t == "relu":
+            return line("RELU")
+        if t is F.gelu or t == "gelu":
+            return line("GELU")
+        if t in (torch.sigmoid, F.sigmoid) or t == "sigmoid":
+            return line("SIGMOID")
+        if t in (torch.tanh, F.tanh) or t == "tanh":
+            return line("TANH")
+        if t in (F.softmax, torch.softmax) or t == "softmax":
+            return line("SOFTMAX")
+        raise NotImplementedError(f".ff export: function {t}")
+    raise NotImplementedError(f".ff export: node op {node.op}")
+
+
+def _ir_to_op(ffmodel: FFModel, op: str, name: str, ins, items):
+    """One .ff line -> one FFModel builder call (reference string_to_ff
+    field orders: LINEAR items[4:7]=out_dim/acti/bias, CONV2D items[4:14],
+    POOL2D items[4:9], EMBEDDING items[4:6], DROPOUT items[4], CONCAT
+    items[4])."""
+    if op == "LINEAR":
+        return ffmodel.dense(ins[0], int(items[4]),
+                             activation=ActiMode(int(items[5])),
+                             use_bias=bool(int(items[6])), name=name)
+    if op == "CONV2D":
+        return ffmodel.conv2d(
+            ins[0], int(items[4]), int(items[5]), int(items[6]),
+            int(items[7]), int(items[8]), int(items[9]), int(items[10]),
+            activation=ActiMode(int(items[11])), groups=int(items[12]),
+            use_bias=bool(int(items[13])), name=name)
+    if op == "POOL2D":
+        k, st, p = int(items[4]), int(items[5]), int(items[6])
+        return ffmodel.pool2d(ins[0], k, k, st, st, p, p,
+                              PoolType(int(items[7])), name=name)
+    if op == "EMBEDDING":
+        return ffmodel.embedding(ins[0], int(items[4]), int(items[5]),
+                                 AggrMode.AGGR_MODE_NONE, name=name)
+    if op == "DROPOUT":
+        return ffmodel.dropout(ins[0], rate=float(items[4]), name=name)
+    if op == "CONCAT":
+        return ffmodel.concat(list(ins), axis=int(items[4]), name=name)
+    if op == "BATCH_NORM":
+        return ffmodel.batch_norm(ins[0], relu=False, name=name)
+    if op == "LAYER_NORM":
+        # the reference importer degrades this to identity (its layernorm
+        # was unsupported, model.py LayerNormNode.string_to_ff); here the
+        # real op exists, normalized over the trailing dim
+        return ffmodel.layer_norm(ins[0], axes=[-1], name=name)
+    if op == "ADD":
+        return ffmodel.add(ins[0], ins[1], name=name)
+    if op == "MULTIPLY":
+        return ffmodel.multiply(ins[0], ins[1], name=name)
+    simple = {"RELU": "relu", "SIGMOID": "sigmoid", "TANH": "tanh",
+              "GELU": "gelu", "IDENTITY": "identity", "FLAT": "flat",
+              "SOFTMAX": "softmax"}
+    if op in simple:
+        return getattr(ffmodel, simple[op])(ins[0], name=name)
+    raise NotImplementedError(f".ff import: op {op}")
+
+
+# module-level alias matching the reference (model.py:2607)
+file_to_ff = PyTorchModel.file_to_ff
+
 
 def _args(env, args):
     return [_lookup(env, a) for a in args]

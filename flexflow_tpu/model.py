@@ -53,6 +53,9 @@ class FFModel:
         # manual-loop staging (API parity: forward/backward/update phases)
         self._staged: Dict[str, Any] = {}
         self._recompile_state = None
+        # {cache_op_name: latest score_fn value} filled during fit
+        # (reference: cache.cc score futures read by the recompile trigger)
+        self.cache_scores: Dict[str, float] = {}
 
     # ======================================================= tensor creation ==
     def create_tensor(self, dims: Sequence[int],
@@ -408,6 +411,39 @@ class FFModel:
         return self.aggregate(topk_values, topk_assign, topk_assign, gate,
                               exp_preds, num_exp, lambda_bal)
 
+    def experts(self, dispatched: Tensor, out_dim: int,
+                activation=ActiMode.AC_MODE_RELU, use_bias: bool = True,
+                name=None) -> Tensor:
+        """Batched expert FFN over a stacked (n, cap, d) dispatch (TPU-native
+        form of the reference's per-expert dense nodes; see ops/moe_ops.py
+        ExpertsOp). Expert-parallel shardable over the expert dim."""
+        n = dispatched.dims[0]
+        return self._unary(OperatorType.OP_EXPERTS, dispatched,
+                           {"n": n, "out_dim": out_dim,
+                            "activation": activation, "use_bias": use_bias},
+                           name)
+
+    def moe_experts(self, input: Tensor, num_exp: int, num_select: int,
+                    expert_hidden_size: int, alpha: float = 2.0,
+                    lambda_bal: float = 0.04) -> Tensor:
+        """MoE layer through the batched Experts op: gate dense -> softmax ->
+        top_k -> stacked group_by -> Experts (one bmm) -> aggregate. Same
+        semantics as ``moe`` (reference src/ops/moe.cc:20-45) but
+        expert-parallel-searchable: the Unity search can shard the expert
+        dim (EP), which XLA lowers to a token all-to-all over ICI."""
+        gate = self.dense(input, num_exp, name="moe_gate")
+        gate = self.softmax(gate)
+        topk_out = self.top_k(gate, num_select)
+        topk_values, topk_assign = topk_out[0], topk_out[1]
+        grouped = self._add_layer(
+            OperatorType.OP_GROUP_BY, [input, topk_assign],
+            {"n": num_exp, "alpha": alpha, "stacked": True},
+            input.dtype, "moe_group_by")
+        exp_out = self.experts(grouped, expert_hidden_size,
+                               name="moe_experts")
+        return self.aggregate(topk_values, topk_assign, topk_assign, gate,
+                              [exp_out], num_exp, lambda_bal)
+
     # ============================================================== compile ==
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
@@ -602,13 +638,24 @@ class FFModel:
         return y
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
-            epochs: Optional[int] = None, callbacks=None) -> PerfMetrics:
+            epochs: Optional[int] = None, callbacks=None,
+            recompile_state=None, shuffle: bool = True) -> PerfMetrics:
         """Training loop (reference: flexflow_cffi.py:2058-2100 — per batch:
         next_batch -> forward -> zero_gradients -> backward -> update inside a
-        Legion trace; here one fused jitted step per batch)."""
+        Legion trace; here one fused jitted step per batch).
+
+        CacheOps in the graph are threaded as a device-side cache pytree;
+        their ``score_fn`` runs host-side every ``num_batches`` steps and the
+        scores land in ``self.cache_scores`` — the signal the MoE
+        cache/recompile pairing consumes (reference: cache.cc:291 +
+        moe.cc:180,204). ``recompile_state`` hooks the per-iteration dynamic
+        recompile check (FFModel::recompile_on_condition, model.cc:2422)."""
         import jax
 
         assert self.executor is not None, "call compile() first"
+        if recompile_state is not None:
+            self._recompile_state = recompile_state
+            recompile_state.ffmodel = self
         xs = self._as_input_list(x)
         y = self._prep_label(y)
         batch_size = batch_size or self.config.batch_size
@@ -625,23 +672,60 @@ class FFModel:
         t0 = time.time()
         step_count = 0
         loss_val = None
-        for epoch in range(epochs):
-            it = batch_iterator(xs + [y], batch_size, shuffle=False)
+        cache = (self.executor.init_cache()
+                 if self.executor.cache_nodes else None)
+        if self.config.profiling:
+            self.profile_operators()
+            t0 = time.time()  # per-op measurement must not skew THROUGHPUT
+        epoch = 0
+        while epoch < epochs:
+            # shuffled epochs by default (the reference's loaders shuffle);
+            # the shuffled path stages batches through the native C++
+            # double-buffered BatchPipeline (data/dataloader.py)
+            it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
+                                seed=self.config.numpy_seed() + epoch)
             epoch_metrics = []  # device-side; folded at epoch end (async)
+            recompiled = False
             for batch in prefetch_iterator(
                     it, in_shardings + [label_sharding]):
                 bx, by = batch[:-1], batch[-1]
-                self.params, self.opt_state, loss_val, m = step_fn(
-                    self.params, self.opt_state, bx, by, self._next_rng())
+                if cache is not None:
+                    (self.params, self.opt_state, loss_val, m,
+                     fresh) = step_fn(self.params, self.opt_state, bx, by,
+                                      self._next_rng(), cache)
+                    self._score_caches(cache, fresh, step_count)
+                    cache.update(fresh)
+                else:
+                    self.params, self.opt_state, loss_val, m = step_fn(
+                        self.params, self.opt_state, bx, by,
+                        self._next_rng())
                 epoch_metrics.append(m)
                 step_count += 1
+                if self._recompile_state is not None and \
+                        self.recompile_on_condition(self._recompile_state):
+                    # executor rebuilt: refresh the jitted step and cache,
+                    # then RE-RUN this epoch on the new shardings (the break
+                    # abandons the rest of its batches)
+                    step_fn = self.executor.make_train_step()
+                    cache = (self.executor.init_cache()
+                             if self.executor.cache_nodes else None)
+                    recompiled = True
+                    break
                 if self.config.profiling and \
                         step_count % max(self.config.print_freq, 1) == 0:
                     print(f"step {step_count}: loss={float(loss_val):.4f}")
+            # fold whatever the epoch produced (also the partial pre-recompile
+            # batches — their steps trained the old graph but still count)
             for m in epoch_metrics:
                 self._perf.update({k: np.asarray(v) for k, v in m.items()})
+            if recompiled:
+                in_shardings = [self.executor.batch_sharding(a.ndim)
+                                for a in xs]
+                label_sharding = self.executor.batch_sharding(y.ndim)
+                continue  # restart the SAME epoch
             if self.config.profiling:
                 print(f"epoch {epoch}: loss={float(loss_val):.4f}")
+            epoch += 1
         if loss_val is not None:
             jax.block_until_ready(loss_val)
         elapsed = time.time() - t0
@@ -725,6 +809,52 @@ class FFModel:
         self._staged["batch"] = (xs, jax.device_put(self._prep_label(y)))
 
     # ---- recompilation (reference: RecompileState, model.cc:2422) -------------
+    def profile_operators(self, max_ops: int = 8) -> None:
+        """Per-op timing printout behind ``--profiling`` (reference:
+        FFConfig::profiling gating per-op kernel timing prints in every
+        kernel wrapper, model.cc:110,155). The ``max_ops`` heaviest distinct
+        op shapes (by analytical cost) are measured standalone via the
+        simulator's microbench (the cudaEvent analog) and printed once —
+        bounded because each measurement pays a jit compile."""
+        if getattr(self, "_per_op_profiled", False) or self.pcg is None:
+            return
+        self._per_op_profiled = True
+        from .search.machine_model import TPUMachineModel
+        from .search.simulator import OpSharding, Simulator
+
+        sim = Simulator(TPUMachineModel.detect(1))
+        distinct = {}
+        for node in self.pcg.compute_nodes():
+            in_shapes = [self.pcg.nodes[g].out_shapes[i]
+                         for g, i in node.inputs]
+            key = sim._op_key(node, in_shapes)
+            if key not in distinct:
+                est = sim.op_cost(node, in_shapes, OpSharding()).forward_time
+                distinct[key] = (est, node, in_shapes)
+        heaviest = sorted(distinct.values(), key=lambda x: -x[0])[:max_ops]
+        print("PER-OP PROFILE (fwd, measured standalone, "
+              f"top {len(heaviest)} by estimated cost):")
+        for _est, node, in_shapes in heaviest:
+            try:
+                t = sim.measure_operator_cost(node, in_shapes)
+            except Exception:
+                continue
+            print(f"  {node.name:24s} {node.op.op_type.name:28s} "
+                  f"{t * 1e6:10.1f} us")
+
+    def _score_caches(self, cache, fresh, step_count: int) -> None:
+        """Host-side cache scoring (reference: cache.cc score tasks): every
+        ``num_batches`` steps run each CacheOp's score_fn(cached, fresh)."""
+        for node in self.executor.cache_nodes:
+            nb = max(int(node.op.attrs.get("num_batches", 1) or 1), 1)
+            if (step_count + 1) % nb:
+                continue
+            score_fn = node.op.attrs.get("score_fn")
+            if score_fn is None:
+                continue
+            self.cache_scores[node.name] = float(score_fn(
+                np.asarray(cache[node.name]), np.asarray(fresh[node.name])))
+
     def recompile_on_condition(self, recompile_state) -> bool:
         if recompile_state.trigger():
             recompile_state.alter(self)

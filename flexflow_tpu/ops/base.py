@@ -41,6 +41,13 @@ class OpContext:
     # reference's lambda_bal term in aggregate.cu backward); the executor adds
     # their sum to the training loss. Shared list across all node contexts.
     aux_losses: Any = None
+    # cache-op state (reference: src/ops/cache.cc + recompile pairing):
+    # cache_in = {op_name: cached_tensor, "__use_cache__": bool scalar} fed
+    # into the step; cache_out = dict the CacheOps fill with fresh values,
+    # returned by the executor's step for host-side scoring. Shared dicts
+    # across all node contexts.
+    cache_in: Any = None
+    cache_out: Any = None
 
 
 # registry: OperatorType -> Op subclass

@@ -1,17 +1,27 @@
-"""Mixture-of-Experts building blocks: GroupBy, Aggregate, AggregateSpec, Cache.
+"""Mixture-of-Experts building blocks: GroupBy, Aggregate, AggregateSpec,
+Experts, Cache.
 
 Reference: src/ops/group_by.cc (534 LoC, ragged scatter with capacity factor
 ``alpha``), aggregate.cc (569, gate-weighted gather + load-balance loss term
 ``lambda_bal``), aggregate_spec.cc (519, speculative variant), cache.cc (291).
 
 TPU-native design (SURVEY §7 hard-part 4): the reference's dynamic ragged
-routing becomes **fixed-capacity dense dispatch** — a one-hot dispatch tensor
-computed from the assignments, contracted on the MXU (the Switch/GShard
-recipe). Capacity = ceil(k * batch * alpha / n), matching the reference's
-definition of its per-expert buffer. Overflowing tokens are dropped exactly as
-the reference drops them when the buffer fills. Both GroupBy and Aggregate
-recompute the same deterministic dispatch from ``assign`` so they stay
-consistent without carrying ragged state.
+routing becomes **fixed-capacity scatter/gather dispatch** — per-token
+destination slots computed from a cumulative count (O(tokens·experts) int32,
+no (tokens, experts, capacity) one-hot blow-up), scattered with
+``.at[].add`` and gathered back by slot index; both directions differentiate
+through XLA. Capacity = ceil(k * batch * alpha / n), matching the
+reference's per-expert buffer; overflowing tokens are dropped exactly as the
+reference drops them when the buffer fills (priority = scan order,
+group_by.cu). GroupBy and Aggregate recompute the same deterministic
+dispatch from ``assign`` so they stay consistent without ragged state.
+
+``Experts`` (OP_EXPERTS) is the TPU-native batched form of the reference's
+per-expert Linear nodes: all experts' FFN weights stacked into one
+(n, d_in, d_out) tensor driven by a batched matmul on the MXU, shardable
+over the expert dim — the expert-parallel strategy the reference expresses
+with per-expert MachineViews becomes one NamedSharding axis, and the
+token all-to-all is emitted by XLA at the sharding boundary.
 """
 from __future__ import annotations
 
@@ -27,14 +37,34 @@ def moe_capacity(k: int, batch: int, alpha: float, n: int) -> int:
     return int(np.ceil(k * batch * alpha / n))
 
 
-def dispatch_mask(assign, n: int, capacity: int):
-    """assign: (tokens,) int in [0, n) -> (tokens, n, capacity) one-hot dispatch.
+def dispatch_indices(assign_flat, n: int, capacity: int):
+    """assign_flat: (t,) int in [0, n) -> (dest (t,), keep (t,)).
 
-    Token priority is index order (the reference packs in scan order,
-    group_by.cu). Tokens past an expert's capacity get an all-zero row (drop).
-    """
-    import jax.numpy as jnp
+    ``dest`` is the flat slot ``expert * capacity + position`` where each
+    token lands; ``keep`` is False for tokens past their expert's capacity
+    (dropped, like the reference when the buffer fills). Position is the
+    token's rank among same-expert tokens in scan order (group_by.cu packs
+    in this order). O(t·n) int32 intermediate — the (t, n, cap) one-hot of
+    the dense-dispatch formulation never materializes."""
     import jax.nn as jnn
+    import jax.numpy as jnp
+
+    onehot = jnn.one_hot(assign_flat, n, dtype=jnp.int32)  # (t, n)
+    pos_all = jnp.cumsum(onehot, axis=0) - 1  # (t, n)
+    pos = jnp.take_along_axis(pos_all, assign_flat[:, None], axis=1)[:, 0]
+    keep = pos < capacity
+    dest = assign_flat * capacity + jnp.clip(pos, 0, capacity - 1)
+    return dest, keep
+
+
+def dispatch_mask(assign, n: int, capacity: int):
+    """assign: (tokens,) -> (tokens, n, capacity) one-hot dispatch tensor.
+
+    Kept as the reference implementation for the alignment tests (grads of
+    the scatter path are verified against it); production ops use
+    ``dispatch_indices``."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
 
     expert_onehot = jnn.one_hot(assign, n, dtype=jnp.int32)  # (t, n)
     pos = jnp.cumsum(expert_onehot, axis=0) * expert_onehot - 1  # (t, n)
@@ -44,19 +74,39 @@ def dispatch_mask(assign, n: int, capacity: int):
     return slot * keep[..., None]  # (t, n, cap) in {0,1}
 
 
+def _scatter_group(x_flat, assign_flat, n: int, cap: int):
+    """(t, d) tokens -> (n, cap, d) expert buffers via scatter-add."""
+    import jax.numpy as jnp
+
+    d = x_flat.shape[-1]
+    dest, keep = dispatch_indices(assign_flat, n, cap)
+    contrib = x_flat * keep[:, None].astype(x_flat.dtype)
+    grouped = jnp.zeros((n * cap, d), x_flat.dtype).at[dest].add(contrib)
+    return grouped.reshape(n, cap, d)
+
+
 @register_op(OperatorType.OP_GROUP_BY)
 class GroupByOp(Op):
-    """attrs: n (num experts), alpha (capacity factor).
+    """attrs: n (num experts), alpha (capacity factor), stacked (bool —
+    TPU-native: emit one (n, cap, d) tensor instead of n (cap, d) tensors,
+    feeding the batched Experts op).
 
     inputs: (input (batch, d), assign (batch, k) int)
     outputs: n tensors of (capacity, d) — reference: FFModel::group_by,
-    src/ops/group_by.cc.
+    src/ops/group_by.cc — or [(n, capacity, d)] when stacked.
     """
 
-    def infer_output_shapes(self, input_shapes):
-        (batch, d), (_, k) = input_shapes
+    def _cap(self, input_shapes):
+        (batch, _d), (_, k) = input_shapes
         n = self.attrs["n"]
-        cap = moe_capacity(k, batch, self.attrs.get("alpha", 1.0), n)
+        return moe_capacity(k, batch, self.attrs.get("alpha", 1.0), n)
+
+    def infer_output_shapes(self, input_shapes):
+        (_batch, d) = input_shapes[0]
+        n = self.attrs["n"]
+        cap = self._cap(input_shapes)
+        if self.attrs.get("stacked"):
+            return [(n, cap, d)]
         return [(cap, d)] * n
 
     def forward(self, params, inputs, ctx: OpContext):
@@ -69,15 +119,101 @@ class GroupByOp(Op):
         cap = moe_capacity(k, batch, self.attrs.get("alpha", 1.0), n)
         assign_flat = assign.reshape(-1).astype(jnp.int32)  # (batch*k,)
         x_flat = jnp.repeat(x, k, axis=0)  # token order matches assign_flat
-        disp = dispatch_mask(assign_flat, n, cap).astype(x.dtype)  # (t, n, c)
-        grouped = jnp.einsum("td,tnc->ncd", x_flat, disp,
-                             preferred_element_type=jnp.float32).astype(x.dtype)
+        grouped = _scatter_group(x_flat, assign_flat, n, cap)
+        if self.attrs.get("stacked"):
+            return [grouped]
         return [grouped[e] for e in range(n)]
 
     def parallelizable_dims(self, input_shapes):
-        # expert parallelism: each output (expert buffer) placeable on its own
-        # submesh (reference: per-expert MachineViews) -> shard the expert dim
+        # expert parallelism: the expert dim shards over the model axis
+        # (reference: per-expert MachineViews)
         return {"batch": False, "expert": True}
+
+
+@register_op(OperatorType.OP_EXPERTS)
+class ExpertsOp(Op):
+    """Batched expert FFN (TPU-native; replaces the reference's n separate
+    Linear ops consuming group_by outputs — src/ops/moe.cc:20-45 builds
+    those): one (n, d_in, out_dim) weight, one batched matmul.
+
+    attrs: n, out_dim, activation, use_bias.
+    inputs: (dispatched (n, cap, d),)
+    output: (n, cap, out_dim).
+    Expert-parallel: shard dim 0 of weights/activations over the model axis.
+    """
+
+    def infer_output_shapes(self, input_shapes):
+        n, cap, _d = input_shapes[0]
+        return [(n, cap, self.attrs["out_dim"])]
+
+    def weight_specs(self, input_shapes):
+        from ..execution.initializers import (DefaultBiasInitializer,
+                                              DefaultWeightInitializer)
+
+        n, _cap, d = input_shapes[0]
+        out = self.attrs["out_dim"]
+        specs = {"kernel": ((n, d, out), self.data_type,
+                            self.attrs.get("kernel_initializer")
+                            or DefaultWeightInitializer())}
+        if self.attrs.get("use_bias", True):
+            specs["bias"] = ((n, out), self.data_type,
+                             DefaultBiasInitializer())
+        return specs
+
+    def forward(self, params, inputs, ctx: OpContext):
+        import jax.numpy as jnp
+
+        (x,) = inputs  # (n, cap, d)
+        y = jnp.einsum("ncd,ndo->nco", x, params["kernel"],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        if "bias" in params:
+            y = y + params["bias"][:, None, :].astype(y.dtype)
+        from ..ffconst import ActiMode
+        from .linear import apply_activation
+
+        return [apply_activation(y, self.attrs.get(
+            "activation", ActiMode.AC_MODE_NONE) or ActiMode.AC_MODE_NONE)]
+
+    def flops(self, input_shapes, output_shapes):
+        n, cap, d = input_shapes[0]
+        return 2 * n * cap * d * self.attrs["out_dim"]
+
+    def parallelizable_dims(self, input_shapes):
+        return {"batch": False, "expert": True}
+
+
+def _combine_tokens(exp_preds, gate_preds, gate_assign, n: int,
+                    weighted: bool = True):
+    """(n, cap, d) expert outputs -> (batch, k, d) per-assignment rows."""
+    import jax.numpy as jnp
+
+    batch, k = gate_assign.shape
+    cap = exp_preds.shape[1]
+    d = exp_preds.shape[2]
+    assign_flat = gate_assign.reshape(-1).astype(jnp.int32)
+    dest, keep = dispatch_indices(assign_flat, n, cap)
+    gathered = exp_preds.reshape(n * cap, d)[dest]  # (t, d)
+    gathered = gathered * keep[:, None].astype(gathered.dtype)
+    if weighted:
+        gathered = gathered * gate_preds.reshape(-1)[:, None].astype(
+            gathered.dtype)
+    return gathered.reshape(batch, k, d)
+
+
+def _load_balance_aux(gate_assign, full_gate, n: int, lambda_bal: float,
+                      ctx: OpContext):
+    """The lambda_bal surrogate (reference: aggregate.cu backward): load_e =
+    fraction of routed (token, k) assignments to expert e — ALL k slots, not
+    just top-1 — times mean gate probability, summed over experts."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    if not lambda_bal or not ctx.training or ctx.aux_losses is None:
+        return
+    assign_all = gate_assign.reshape(-1).astype(jnp.int32)  # (batch*k,)
+    load = jnp.mean(jnn.one_hot(assign_all, n, dtype=jnp.float32), axis=0)
+    importance = jnp.mean(full_gate.astype(jnp.float32), axis=0)
+    ctx.aux_losses.append(lambda_bal * n * jnp.sum(load * importance))
 
 
 @register_op(OperatorType.OP_AGGREGATE)
@@ -86,88 +222,89 @@ class AggregateOp(Op):
 
     inputs: (gate_preds (batch, k), gate_assign (batch, k),
              true_gate_assign (batch, k), full_gate_grads (batch, n),
-             exp_pred_0..exp_pred_{n-1} each (capacity, d))
+             exp_pred_0..exp_pred_{n-1} each (capacity, d) — or one stacked
+             (n, capacity, d) tensor)
     output: (batch, d) — reference: src/ops/aggregate.cc. The load-balance
-    term flows through autodiff via the gate contraction (the reference
+    term flows through autodiff via the aux-loss hook (the reference
     hand-codes it in aggregate.cu's backward).
     """
 
     def infer_output_shapes(self, input_shapes):
         batch = input_shapes[0][0]
-        d = input_shapes[4][1]
+        d = input_shapes[4][-1]
         return [(batch, d)]
 
     def forward(self, params, inputs, ctx: OpContext):
         import jax.numpy as jnp
-        import jax.nn as jnn
 
         gate_preds, gate_assign = inputs[0], inputs[1]
-        exp_preds = jnp.stack(inputs[4:], axis=0)  # (n, cap, d)
-        batch, k = gate_assign.shape
+        if len(inputs) == 5 and inputs[4].ndim == 3:
+            exp_preds = inputs[4]  # stacked (n, cap, d)
+        else:
+            exp_preds = jnp.stack(inputs[4:], axis=0)
         n = self.attrs["n"]
-        cap = exp_preds.shape[1]
-        assign_flat = gate_assign.reshape(-1).astype(jnp.int32)
-        disp = dispatch_mask(assign_flat, n, cap)  # (t, n, c)
-        combine = disp.astype(gate_preds.dtype) * gate_preds.reshape(-1)[:, None, None]
-        out_flat = jnp.einsum("tnc,ncd->td", combine, exp_preds,
-                              preferred_element_type=jnp.float32)
-        out = out_flat.reshape(batch, k, -1).sum(axis=1)
-        # load-balance auxiliary loss (reference: lambda_bal term applied in
-        # aggregate.cu's backward): n * sum_e(load_e * importance_e), the
-        # Switch/GShard differentiable surrogate. full_gate_grads = gate
-        # probabilities over all n experts (batch, n).
-        lambda_bal = self.attrs.get("lambda_bal", 0.0)
-        if lambda_bal and ctx.training and ctx.aux_losses is not None:
-            full_gate = inputs[3].astype(jnp.float32)  # (batch, n)
-            load = jnp.mean(
-                jnn.one_hot(gate_assign[:, 0].astype(jnp.int32), n,
-                            dtype=jnp.float32), axis=0)  # top-1 token fraction
-            importance = jnp.mean(full_gate, axis=0)
-            ctx.aux_losses.append(lambda_bal * n * jnp.sum(load * importance))
+        rows = _combine_tokens(exp_preds, gate_preds, gate_assign, n)
+        out = rows.sum(axis=1)  # (batch, d)
+        _load_balance_aux(gate_assign, inputs[3], n,
+                          self.attrs.get("lambda_bal", 0.0), ctx)
         return [out.astype(exp_preds.dtype)]
 
 
 @register_op(OperatorType.OP_AGG_SPEC)
 class AggregateSpecOp(Op):
     """Speculative aggregation: one output row per (token, assignment) so the
-    loss supervises every expert's prediction; labels are replicated k times by
-    compile (reference: aggregate_spec.cc; model.cc:2875-2877).
+    loss supervises every expert's prediction; labels are replicated k times
+    by compile (reference: aggregate_spec.cc; model.cc:2875-2877).
     """
 
     def infer_output_shapes(self, input_shapes):
         batch, k = input_shapes[1]
-        d = input_shapes[4][1]
+        d = input_shapes[4][-1]
         return [(batch * k, d)]
 
     def forward(self, params, inputs, ctx: OpContext):
         import jax.numpy as jnp
 
         gate_assign = inputs[1]
-        exp_preds = jnp.stack(inputs[4:], axis=0)
-        batch, k = gate_assign.shape
+        if len(inputs) == 5 and inputs[4].ndim == 3:
+            exp_preds = inputs[4]
+        else:
+            exp_preds = jnp.stack(inputs[4:], axis=0)
         n = self.attrs["n"]
-        cap = exp_preds.shape[1]
-        assign_flat = gate_assign.reshape(-1).astype(jnp.int32)
-        disp = dispatch_mask(assign_flat, n, cap).astype(exp_preds.dtype)
-        out = jnp.einsum("tnc,ncd->td", disp, exp_preds,
-                         preferred_element_type=jnp.float32)
-        return [out.astype(exp_preds.dtype)]
+        batch, k = gate_assign.shape
+        rows = _combine_tokens(exp_preds, None, gate_assign, n,
+                               weighted=False)
+        _load_balance_aux(gate_assign, inputs[3], n,
+                          self.attrs.get("lambda_bal", 0.0), ctx)
+        return [rows.reshape(batch * k, -1).astype(exp_preds.dtype)]
 
 
 @register_op(OperatorType.OP_CACHE)
 class CacheOp(Op):
     """Caches an intermediate tensor across iterations, re-using it while a
     user score function deems it fresh (reference: src/ops/cache.cc:291; pairs
-    with dynamic recompile, recompile.h). Functionally: the executor threads a
-    ``cache_state`` aux pytree; forward selects cached vs fresh value.
+    with dynamic recompile, recompile.h). The executor threads a cache-state
+    pytree: forward blends the cached value in via ``ctx.cache_in`` and
+    publishes the fresh value through ``ctx.cache_out`` (the executor's
+    train/eval step returns it; FFModel.fit scores it host-side with
+    ``score_fn`` and feeds the recompile trigger).
 
-    attrs: num_batches, score_fn (callable(cached, fresh) -> float, host-side).
+    attrs: num_batches, score_fn (callable(cached, fresh) -> float).
     """
 
     def infer_output_shapes(self, input_shapes):
         return [input_shapes[0]]
 
     def forward(self, params, inputs, ctx: OpContext):
-        # Cache state handling lives in the executor (aux-state pytree); inside
-        # the pure graph the op is identity on its input.
-        return [inputs[0]]
+        fresh = inputs[0]
+        if ctx.cache_out is not None:
+            ctx.cache_out[self.name] = fresh
+        if ctx.cache_in is not None and self.name in ctx.cache_in:
+            use_cache = ctx.cache_in.get("__use_cache__")
+            if use_cache is not None:
+                import jax.numpy as jnp
+
+                cached = ctx.cache_in[self.name]
+                return [jnp.where(use_cache, cached.astype(fresh.dtype),
+                                  fresh)]
+        return [fresh]

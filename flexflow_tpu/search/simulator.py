@@ -32,7 +32,7 @@ _MATMUL_OPS = {
     OperatorType.OP_LINEAR, OperatorType.OP_CONV2D,
     OperatorType.OP_BATCHMATMUL, OperatorType.OP_MULTIHEAD_ATTENTION,
     OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
-    OperatorType.OP_AGG_SPEC,
+    OperatorType.OP_AGG_SPEC, OperatorType.OP_EXPERTS,
 }
 
 
@@ -347,13 +347,9 @@ class Simulator:
         ``iters`` is sized from the analytical estimate to push total device
         time well past the round trip, which is separately measured with an
         identity jit and subtracted."""
-        key = self._op_key(node, in_shapes)
+        key = self._op_key(node, in_shapes) + (str(compute_dtype),)
         if key in self._measure_cache:
             return self._measure_cache[key]
-        if iters is None:
-            est = self.op_cost(node, in_shapes, OpSharding()).forward_time
-            # target ~0.4 s of device work (≳5x the observed ~75 ms RTT)
-            iters = int(min(max(0.4 / max(est, 1e-6), 16), 4096))
         import time
 
         import jax
@@ -375,20 +371,23 @@ class Simulator:
             params[wname] = w
         ctx = OpContext(training=False)
 
-        @jax.jit
-        def f(params, xs):
-            def body(carry, _):
-                cur, acc = carry
-                outs = op.forward(params, cur, ctx)
-                leaf = jax.tree_util.tree_leaves(outs)[0].astype(jnp.float32)
-                s = jnp.vdot(leaf, leaf) * 1e-30
-                nxt = [x * (1.0 + s).astype(x.dtype) if jnp.issubdtype(
-                    x.dtype, jnp.floating) else x for x in cur]
-                return (nxt, acc + s), ()
+        def make_f(n_iters):
+            @jax.jit
+            def f(params, xs):
+                def body(carry, _):
+                    cur, acc = carry
+                    outs = op.forward(params, cur, ctx)
+                    leaf = jax.tree_util.tree_leaves(outs)[0].astype(
+                        jnp.float32)
+                    s = jnp.vdot(leaf, leaf) * 1e-30
+                    nxt = [x * (1.0 + s).astype(x.dtype) if jnp.issubdtype(
+                        x.dtype, jnp.floating) else x for x in cur]
+                    return (nxt, acc + s), ()
 
-            (_, acc), _ = jax.lax.scan(body, (list(xs), jnp.zeros(())),
-                                       None, length=iters)
-            return acc
+                (_, acc), _ = jax.lax.scan(body, (list(xs), jnp.zeros(())),
+                                           None, length=n_iters)
+                return acc
+            return f
 
         def timed(fn, *args):
             out = fn(*args)  # compile + settle
@@ -406,8 +405,24 @@ class Simulator:
             probe = jnp.ones((8, 8), jnp.float32)
             self._dispatch_overhead = timed(
                 lambda x: jnp.sum(ident(x)), probe)
-        total = timed(f, params, xs)
-        t = max((total - self._dispatch_overhead) / iters, 1e-7)
+        overhead = self._dispatch_overhead
+        if iters is None:
+            if overhead < 0.01:
+                # local backend (CPU mesh / directly-attached chip): a small
+                # probe gives real per-iter signal without long scans — the
+                # analytical estimate uses TPU peak rates and would oversize
+                # the iteration count by ~1000x on CPU
+                iters = 8
+            else:
+                # tunneled TPU: ~75 ms RTT hides device work under async
+                # dispatch, so size total device time well past it from the
+                # analytical estimate (near-truth on the real chip)
+                est = self.op_cost(node, in_shapes,
+                                   OpSharding()).forward_time
+                target = max(5.0 * overhead, 0.4)
+                iters = int(min(max(target / max(est, 1e-6), 16), 4096))
+        total = timed(make_f(iters), params, xs)
+        t = max((total - overhead) / iters, 1e-7)
         self._measure_cache[key] = t
         return t
 

@@ -208,10 +208,6 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     from ..ffconst import size_of_datatype
 
     nodes = pcg.compute_nodes()
-    consumers: Dict[int, int] = {}
-    for n in nodes:
-        for g, _ in n.inputs:
-            consumers[g] = consumers.get(g, 0) + 1
     sink_guids = {n.guid for n in pcg.sinks()}
 
     def mix(time_s: float, mem_bytes: float) -> float:
@@ -390,9 +386,12 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
             ns.weight_specs = {"kernel": (None, None, None, model_axis),
                                "bias": (model_axis,)}
         elif ot == OperatorType.OP_EXPERTS:
+            # expert parallel: dim 0 is the expert dim, not batch — weights
+            # and activations ride the model axis; XLA inserts the token
+            # all-to-all at the dispatch/combine boundaries
             ns.weight_specs = {"kernel": (model_axis, None, None),
                                "bias": (model_axis, None)}
-            ns.output_spec = state_spec("R", ndim)
+            ns.output_spec = (model_axis,) + (None,) * (ndim - 1)
     return s
 
 
@@ -536,6 +535,34 @@ def _in_state_of(node: PCGNode, assignment: Dict[int, OpSharding],
 
 
 # ------------------------------------------------------------ best-first xfers
+def apply_all_matches(pcg: PCG, xfers,
+                      protected_guids: Sequence[int] = ()) -> Tuple[PCG, int]:
+    """Greedily apply every match of always-beneficial rewrites (activation
+    fusion strictly removes an op under the roofline model — the reference
+    applies such monotonic rules as simplification passes, Graph::simplify,
+    rather than spending base_optimize budget). Returns (graph, #applied)."""
+    g = pcg
+    applied = 0
+    changed = True
+    while changed and applied < len(pcg.nodes):
+        changed = False
+        for xfer in xfers:
+            matches = xfer.find_matches(g)
+            for match in matches:
+                if any(guid in protected_guids for guid in match.values()):
+                    continue
+                try:
+                    g = xfer.apply(g, match)
+                except Exception:
+                    continue
+                applied += 1
+                changed = True
+                break  # re-match on the rewritten graph
+            if changed:
+                break
+    return g, applied
+
+
 def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         batch: int, xfers, budget: int, alpha: float,
                         space: Optional[SearchSpace] = None,
@@ -614,6 +641,15 @@ def unity_search(pcg: PCG, config, n_dev: int,
         _log.info("calibrated %d op shapes on device", n_measured)
 
     xfers = _load_xfers(config)
+    # monotonic rewrites (activation fusion) apply greedily up front — one
+    # pass instead of budgeted re-search per factorization; the best-first
+    # loop keeps the cost-gated rules (--substitution-json)
+    from .substitution import builtin_xfers
+
+    fusion_names = {x.name for x in builtin_xfers()}
+    greedy = [x for x in xfers if x.name in fusion_names]
+    xfers = [x for x in xfers if x.name not in fusion_names]
+    base_pcg, n_fused = apply_all_matches(pcg, greedy, protected_guids)
     # the Unity graph search explores the full parameter/attribute space like
     # the reference's (the enable_* flags gate only MCMC, linear.cc:727);
     # sequence parallelism is a TPU-native extension with its own opt-out
@@ -637,9 +673,9 @@ def unity_search(pcg: PCG, config, n_dev: int,
             if batch % dp != 0:
                 continue
             g, a, s, t = best_first_optimize(
-                pcg, sim, dp, tp, batch, xfers, budget=max(budget // 4, 4),
-                alpha=alpha, space=space, lam=lam,
-                protected_guids=protected_guids)
+                base_pcg, sim, dp, tp, batch, xfers,
+                budget=max(budget // 4, 4), alpha=alpha, space=space,
+                lam=lam, protected_guids=protected_guids)
             _, mem = sim.simulate(g, a, s)
             _log.info("mesh dp=%d tp=%d lam=%.2f -> %.3f ms, %.1f MiB/chip",
                       dp, tp, lam, t * 1e3, mem / 2 ** 20)

@@ -1,0 +1,156 @@
+"""MoE at scale (VERDICT round-1 item 8): scatter/gather dispatch
+equivalence with the dense-dispatch formulation, all-k load-balance term,
+batched Experts op, EP in the search space, >=8-expert training."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import OpContext
+from flexflow_tpu.ops.moe_ops import (dispatch_indices, dispatch_mask,
+                                      moe_capacity)
+
+
+def test_scatter_dispatch_matches_dense_dispatch():
+    """Forward AND gradients of the scatter-based group_by must match the
+    (t, n, cap) one-hot einsum formulation on small shapes."""
+    rng = np.random.default_rng(0)
+    t, d, n, cap = 24, 8, 4, 8
+    x = jnp.asarray(rng.normal(size=(t, d)).astype(np.float32))
+    assign = jnp.asarray(rng.integers(0, n, size=(t,)).astype(np.int32))
+
+    def grouped_scatter(x):
+        from flexflow_tpu.ops.moe_ops import _scatter_group
+
+        return _scatter_group(x, assign, n, cap)
+
+    def grouped_dense(x):
+        disp = dispatch_mask(assign, n, cap).astype(x.dtype)
+        return jnp.einsum("td,tnc->ncd", x, disp)
+
+    np.testing.assert_allclose(grouped_scatter(x), grouped_dense(x),
+                               rtol=1e-5, atol=1e-5)
+    # gradients through a downstream reduction
+    g1 = jax.grad(lambda x: jnp.sum(jnp.sin(grouped_scatter(x))))(x)
+    g2 = jax.grad(lambda x: jnp.sum(jnp.sin(grouped_dense(x))))(x)
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5)
+
+
+def test_dispatch_drops_overflow_tokens():
+    """Tokens past capacity are dropped in scan order, like the reference's
+    full buffer (group_by.cu)."""
+    assign = jnp.asarray([0, 0, 0, 1], dtype=jnp.int32)
+    dest, keep = dispatch_indices(assign, n=2, capacity=2)
+    np.testing.assert_array_equal(np.asarray(keep), [True, True, False, True])
+    np.testing.assert_array_equal(np.asarray(dest)[:2], [0, 1])
+
+
+def test_lambda_bal_covers_all_k():
+    """The load-balance term must count every routed assignment (all k),
+    not only top-1 (reference: aggregate.cu backward's lambda_bal)."""
+    from flexflow_tpu.ops.moe_ops import AggregateOp
+
+    n, batch, k, cap, d = 4, 8, 2, 8, 4
+    # top-1 always expert 0; second choice spreads over experts 1..3
+    gate_assign = jnp.stack(
+        [jnp.zeros(batch, jnp.int32),
+         jnp.asarray([1, 2, 3, 1, 2, 3, 1, 2], jnp.int32)], axis=1)
+    gate_preds = jnp.full((batch, k), 0.5)
+    full_gate = jnp.full((batch, n), 0.25)
+    exp_preds = jnp.ones((n, cap, d))
+    op = AggregateOp("agg", {"n": n, "lambda_bal": 1.0}, None, num_inputs=5)
+    aux = []
+    ctx = OpContext(training=True, aux_losses=aux)
+    op.forward({}, [gate_preds, gate_assign, gate_assign, full_gate,
+                    exp_preds], ctx)
+    assert len(aux) == 1
+    # all-k load = [.5, .1875, .1875, .125]; top-1-only load would be
+    # [1, 0, 0, 0] giving aux = 4 * 0.25 = 1.0; all-k gives 4 * 0.25 *
+    # sum(load)=1 * ... compute expected:
+    load = np.asarray([0.5, 3 / 16, 3 / 16, 2 / 16])
+    expected = 1.0 * n * float(np.sum(load * 0.25))
+    np.testing.assert_allclose(float(aux[0]), expected, rtol=1e-5)
+
+
+def test_moe_experts_trains_at_8_experts():
+    """The batched-Experts MoE path trains at 8 experts / realistic batch
+    and its step memory has no (t, n, cap) term."""
+    config = FFConfig()
+    config.batch_size = 64
+    ff = FFModel(config)
+    x = ff.create_tensor((64, 64), name="in")
+    t = ff.dense(x, 64)
+    t = ff.moe_experts(t, num_exp=8, num_select=2, expert_hidden_size=64,
+                       alpha=1.5, lambda_bal=0.01)
+    ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(128, 64)).astype(np.float32)
+    w = rng.normal(size=(64, 4)).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1)[:, None].astype(np.int32)
+    estep = ff.executor.make_eval_step()
+    bx = [jax.device_put(xs[:64], ff.executor.batch_sharding(2))]
+    by = jax.device_put(ys[:64], ff.executor.batch_sharding(2))
+    loss0 = float(estep(ff.params, bx, by)[0])
+    ff.fit(xs, ys, epochs=8)
+    loss1 = float(estep(ff.params, bx, by)[0])
+    assert loss1 < loss0, (loss0, loss1)
+    # experts weights exist as one stacked tensor
+    names = [k for k in ff.params if "moe_experts" in k]
+    assert names and ff.params[names[0]]["kernel"].shape == (8, 64, 64)
+
+
+def test_search_discovers_expert_parallelism():
+    """unity_search must pick kind='expert' for the Experts op on a
+    compute-heavy MoE model (VERDICT item 2's EP Done criterion)."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+    from flexflow_tpu.search.unity import dp_assign, unity_search
+
+    config = FFConfig()
+    config.batch_size = 32
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 1024), name="in")
+    t = ff.moe_experts(x, num_exp=8, num_select=2,
+                       expert_hidden_size=4096, alpha=1.0)
+    ff.softmax(ff.dense(t, 8))
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    assignment, states, _t = dp_assign(pcg, sim, dp=1, tp=8, batch_size=32)
+    experts_nodes = [n for n in pcg.compute_nodes()
+                     if n.op.op_type == OperatorType.OP_EXPERTS]
+    assert experts_nodes
+    assert assignment[experts_nodes[0].guid].kind == "expert"
+    # and EP beats pure DP in simulation on this model
+    res = unity_search(pcg, config, 8, machine=machine, return_result=True)
+    dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    t_dp, _ = sim.simulate(pcg, dp8)
+    assert res.sim_time <= t_dp * 1.001
+
+
+def test_moe_experts_ep_strategy_executes():
+    """Hand-pinned EP strategy over the (data, model) mesh executes the
+    moe_experts path on the 8-device CPU mesh (all-to-all emitted by XLA)."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.unity import unity_search
+
+    config = FFConfig()
+    config.batch_size = 32
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 128), name="in")
+    t = ff.moe_experts(x, num_exp=8, num_select=2, expert_hidden_size=256,
+                       alpha=1.0)
+    ff.softmax(ff.dense(t, 4))
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy_fn=lambda pcg: unity_search(pcg, config, 8,
+                                                    machine=machine))
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 128)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(64, 1)).astype(np.int32)
+    ff.fit(xs, ys, epochs=1)
