@@ -63,6 +63,10 @@ class ONNXModel:
         for node in self.model.graph.node:
             op = node.op_type
             ins = [env.get(i) for i in node.input]
+            custom = self._custom_handler(op)
+            if custom is not None:
+                env[node.output[0]] = custom(ffmodel, node, ins, attr)
+                continue
             if op == "Gemm" or op == "MatMul":
                 w = self.initializers[node.input[1]]
                 out_dim = w.shape[1] if op == "MatMul" else (
@@ -171,3 +175,41 @@ class ONNXModel:
                 raise NotImplementedError(f"ONNX op {op}")
             env[node.output[0]] = t
         return [env[o.name] for o in self.model.graph.output]
+
+    def _custom_handler(self, op: str):
+        """Subclass hook: return a handler(ffmodel, node, ins, attr) to
+        override the default dispatch for ``op`` (ONNXModelKeras)."""
+        return None
+
+
+class ONNXModelKeras(ONNXModel):
+    """Importer for keras-exported ONNX graphs (reference:
+    python/flexflow/onnx/model.py:340 ``ONNXModelKeras``): keras exporters
+    put a Transpose on the dense-weight path (the kernel is stored
+    transposed) — that Transpose is resolved at import time by aliasing the
+    transposed initializer under its output name, so the downstream
+    Gemm/MatMul sees the right out_dim; activation-path Transposes stay real
+    ops. Reshape flattens like the reference's handleReshape ->
+    handleFlatten. ``ffconfig``/``ffmodel`` are accepted for reference API
+    compatibility (the reference uses them to create constant tensors for
+    keras bias initializers; here biases import through the regular path)."""
+
+    def __init__(self, filename_or_model, ffconfig=None, ffmodel=None):
+        super().__init__(filename_or_model)
+
+    def _custom_handler(self, op: str):
+        if op == "Transpose":
+            def handle_transpose(ffmodel, node, ins, attr):
+                src = node.input[0]
+                if src in self.initializers:
+                    w = self.initializers[src]
+                    perm = attr(node, "perm", list(range(w.ndim))[::-1])
+                    self.initializers[node.output[0]] = \
+                        np.transpose(w, perm)
+                    return None  # weight path: no graph op
+                return ffmodel.transpose(ins[0], attr(node, "perm"))
+
+            return handle_transpose
+        if op == "Reshape":
+            return lambda ffmodel, node, ins, attr: ffmodel.flat(ins[0])
+        return None

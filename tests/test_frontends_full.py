@@ -224,3 +224,63 @@ def test_onnx_new_ops_split_gap_unsqueeze():
     x_t = ff.create_tensor((2, 8, 4, 4))
     outs = ONNXModel(model).apply(ff, {"x": x_t})
     assert outs[0].dims == (2, 4)
+
+
+def test_onnx_keras_transpose_weight_alias():
+    """ONNXModelKeras resolves weight-path Transposes by aliasing the
+    transposed initializer (no onnx package needed: the handler only reads
+    node.input/output + the attr callable)."""
+    from types import SimpleNamespace
+
+    from flexflow_tpu.frontends.onnx import ONNXModelKeras
+
+    m = ONNXModelKeras.__new__(ONNXModelKeras)  # skip onnx load
+    m.initializers = {"W": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    node = SimpleNamespace(input=["W"], output=["W_t"])
+    handler = m._custom_handler("Transpose")
+    out = handler(None, node, [None], lambda n, k, d=None: d)
+    assert out is None
+    np.testing.assert_array_equal(m.initializers["W_t"],
+                                  m.initializers["W"].T)
+    # activation-path transpose falls through to a real op
+    calls = {}
+
+    class FF:
+        def transpose(self, x, perm):
+            calls["perm"] = perm
+            return "transposed"
+
+    node2 = SimpleNamespace(input=["act"], output=["act_t"])
+    got = handler(FF(), node2, ["act_tensor"],
+                  lambda n, k, d=None: [0, 2, 1] if k == "perm" else d)
+    assert got == "transposed" and calls["perm"] == [0, 2, 1]
+
+
+def test_onnx_keras_full_graph():
+    """Full keras-style graph (Transpose on the weight path + MatMul + Add)
+    through ONNXModelKeras.apply."""
+    onnx = pytest.importorskip("onnx")
+    from onnx import TensorProto, helper, numpy_helper
+
+    from flexflow_tpu.frontends.onnx import ONNXModelKeras
+
+    w = np.zeros((8, 16), dtype=np.float32)  # keras stores (out, in)
+    b = np.zeros((8,), dtype=np.float32)
+    nodes = [
+        helper.make_node("Transpose", ["W"], ["W_t"], perm=[1, 0]),
+        helper.make_node("MatMul", ["x", "W_t"], ["h"]),
+        helper.make_node("Relu", ["h"], ["y"]),
+    ]
+    graph = helper.make_graph(
+        nodes, "keras_style",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT, [4, 16])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT, [4, 8])],
+        initializer=[numpy_helper.from_array(w, "W"),
+                     numpy_helper.from_array(b, "b")])
+    model = helper.make_model(graph)
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x_t = ff.create_tensor((4, 16))
+    outs = ONNXModelKeras(model).apply(ff, {"x": x_t})
+    assert outs[0].dims == (4, 8)
