@@ -207,9 +207,26 @@ class ONNXModelKeras(ONNXModel):
                     self.initializers[node.output[0]] = \
                         np.transpose(w, perm)
                     return None  # weight path: no graph op
-                return ffmodel.transpose(ins[0], attr(node, "perm"))
+                # ONNX default perm = reversed axes
+                ndim = len(ins[0].dims)
+                perm = attr(node, "perm", list(range(ndim))[::-1])
+                return ffmodel.transpose(ins[0], perm)
 
             return handle_transpose
         if op == "Reshape":
             return lambda ffmodel, node, ins, attr: ffmodel.flat(ins[0])
+        if op == "Add":
+            def handle_add(ffmodel, node, ins, attr):
+                # keras Dense(use_bias=True) exports MatMul + Add(h, bias)
+                # with the bias as an initializer — promote it to a graph
+                # constant (the reference creates constant tensors for this,
+                # onnx/model.py ONNXModelKeras._create_initializer_tensor)
+                vals = []
+                for name, v in zip(node.input, ins):
+                    if v is None and name in self.initializers:
+                        v = ffmodel.constant(self.initializers[name])
+                    vals.append(v)
+                return ffmodel.add(vals[0], vals[1])
+
+            return handle_add
         return None

@@ -86,7 +86,11 @@ class RMSNormOp(Op):
 
 @register_op(OperatorType.OP_SOFTMAX)
 class SoftmaxOp(Op):
-    """attrs: axis (reference: src/ops/softmax.cc; -1 default like FFModel::softmax)."""
+    """attrs: axis (reference: src/ops/softmax.cc; -1 default like
+    FFModel::softmax), use_pallas (opt-in: route MXU-aligned last-dim rows
+    through the Pallas row-softmax kernel, kernels/softmax.py — the cuDNN
+    softmax analog; XLA's fusion measured at parity on v5e, so the default
+    path stays jax.nn.softmax)."""
 
     def infer_output_shapes(self, input_shapes):
         return [input_shapes[0]]
@@ -95,4 +99,11 @@ class SoftmaxOp(Op):
         import jax.nn as jnn
 
         (x,) = inputs
-        return [jnn.softmax(x, axis=self.attrs.get("axis", -1))]
+        axis = self.attrs.get("axis", -1)
+        from ..kernels.softmax import (pallas_softmax,
+                                       should_use_pallas_softmax)
+
+        if should_use_pallas_softmax(
+                x, axis, opt_in=bool(self.attrs.get("use_pallas"))):
+            return [pallas_softmax(x)]
+        return [jnn.softmax(x, axis=axis)]

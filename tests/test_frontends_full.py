@@ -251,9 +251,41 @@ def test_onnx_keras_transpose_weight_alias():
             return "transposed"
 
     node2 = SimpleNamespace(input=["act"], output=["act_t"])
-    got = handler(FF(), node2, ["act_tensor"],
+    act = SimpleNamespace(dims=(2, 3, 4))
+    got = handler(FF(), node2, [act],
                   lambda n, k, d=None: [0, 2, 1] if k == "perm" else d)
     assert got == "transposed" and calls["perm"] == [0, 2, 1]
+    # perm omitted: ONNX default = reversed axes
+    got = handler(FF(), node2, [act], lambda n, k, d=None: d)
+    assert calls["perm"] == [2, 1, 0]
+
+
+def test_onnx_keras_bias_add_promotes_initializer():
+    """Add(h, bias-initializer) — the canonical keras Dense(use_bias=True)
+    export — promotes the bias to a graph constant."""
+    from types import SimpleNamespace
+
+    from flexflow_tpu.frontends.onnx import ONNXModelKeras
+
+    m = ONNXModelKeras.__new__(ONNXModelKeras)
+    m.initializers = {"b": np.ones(8, dtype=np.float32)}
+    calls = {}
+
+    class FF:
+        def constant(self, arr):
+            calls["const"] = arr
+            return "const_tensor"
+
+        def add(self, a, b):
+            calls["add"] = (a, b)
+            return "sum"
+
+    node = SimpleNamespace(input=["h", "b"], output=["hb"])
+    handler = m._custom_handler("Add")
+    got = handler(FF(), node, ["h_tensor", None], lambda n, k, d=None: d)
+    assert got == "sum"
+    np.testing.assert_array_equal(calls["const"], np.ones(8))
+    assert calls["add"] == ("h_tensor", "const_tensor")
 
 
 def test_onnx_keras_full_graph():
@@ -269,7 +301,8 @@ def test_onnx_keras_full_graph():
     nodes = [
         helper.make_node("Transpose", ["W"], ["W_t"], perm=[1, 0]),
         helper.make_node("MatMul", ["x", "W_t"], ["h"]),
-        helper.make_node("Relu", ["h"], ["y"]),
+        helper.make_node("Add", ["h", "b"], ["hb"]),  # bias initializer
+        helper.make_node("Relu", ["hb"], ["y"]),
     ]
     graph = helper.make_graph(
         nodes, "keras_style",
