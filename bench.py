@@ -111,12 +111,18 @@ def main():
     # returns before the device work completes
     _ = float(loss)
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                          jrandom.PRNGKey(100 + i))
-    _ = float(loss)
-    dt = (time.perf_counter() - t0) / iters
+    # median of 3 timing windows: single-window numbers swing ~8% run to
+    # run on the tunneled chip
+    windows = []
+    for w in range(3):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                              jrandom.PRNGKey(100 + w * iters
+                                                              + i))
+        _ = float(loss)
+        windows.append((time.perf_counter() - t0) / iters)
+    dt = sorted(windows)[1]
 
     samples_per_sec = cfg.batch_size / dt
     flops_per_step = bert_train_flops_per_step(cfg)

@@ -190,9 +190,10 @@ class ONNXModelKeras(ONNXModel):
     transposed initializer under its output name, so the downstream
     Gemm/MatMul sees the right out_dim; activation-path Transposes stay real
     ops. Reshape flattens like the reference's handleReshape ->
-    handleFlatten. ``ffconfig``/``ffmodel`` are accepted for reference API
-    compatibility (the reference uses them to create constant tensors for
-    keras bias initializers; here biases import through the regular path)."""
+    handleFlatten; Add with a bias-initializer operand (the
+    Dense(use_bias=True) export) promotes the bias to a graph constant —
+    the reference's ``_create_initializer_tensor`` behavior.
+    ``ffconfig``/``ffmodel`` are accepted for reference API compat only."""
 
     def __init__(self, filename_or_model, ffconfig=None, ffmodel=None):
         super().__init__(filename_or_model)

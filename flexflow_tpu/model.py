@@ -268,8 +268,13 @@ class FFModel:
     def flat(self, x, name=None):
         return self._unary(OperatorType.OP_FLAT, x, name=name)
 
-    def softmax(self, x, axis: int = -1, name=None):
-        return self._unary(OperatorType.OP_SOFTMAX, x, {"axis": axis}, name)
+    def softmax(self, x, axis: int = -1, name=None,
+                use_pallas: bool = False):
+        """use_pallas opts aligned last-axis rows into the Pallas row-softmax
+        kernel on TPU (kernels/softmax.py; default jax.nn.softmax — measured
+        at parity on v5e, see the kernel docstring)."""
+        return self._unary(OperatorType.OP_SOFTMAX, x,
+                           {"axis": axis, "use_pallas": use_pallas}, name)
 
     def reshape(self, x, shape: Sequence[int], name=None):
         return self._unary(OperatorType.OP_RESHAPE, x,
