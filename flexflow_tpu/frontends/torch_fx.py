@@ -505,8 +505,28 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         if t == "flatten":
             return ffmodel.flat(x)
         if t == "mean":
-            return ffmodel.mean(x, dims=[args[1]] if len(args) > 1 else [-1],
+            dims = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ffmodel.mean(x, dims=_reduce_dims(dims),
                                 keepdims=kwargs.get("keepdim", False))
+        if t == "sum":
+            dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            if dims is None:
+                raise NotImplementedError("full-reduce sum")
+            return ffmodel.reduce_sum(x, axes=_reduce_dims(dims),
+                                      keepdims=kwargs.get("keepdim", False))
+        if t == "pow":
+            return ffmodel.pow(x, args[1])
+        if t == "rsqrt":
+            return ffmodel.rsqrt(x)
+        if t == "exp":
+            return ffmodel.exp(x)
+        if t in ("split", "chunk"):
+            return _convert_split(ffmodel, x, args[1:], kwargs,
+                                  is_chunk=(t == "chunk"))
+        if t == "unsqueeze":
+            return _convert_unsqueeze(ffmodel, x, args[1:], kwargs)
+        if t == "squeeze":
+            return _convert_squeeze(ffmodel, x, args[1:], kwargs)
         if t == "to":
             target = args[1] if len(args) > 1 else kwargs.get("dtype")
             from ..ffconst import jnp_to_dtype
@@ -616,7 +636,79 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return ffmodel.dropout(args[0], rate=kwargs.get("p", 0.5))
     if t is getattr(torch, "pow", None) or t is operator.pow:
         return ffmodel.pow(args[0], args[1])
+    if t is torch.rsqrt:
+        return ffmodel.rsqrt(args[0])
+    if t is torch.exp:
+        return ffmodel.exp(args[0])
+    if t is torch.sin:
+        return ffmodel.sin(args[0])
+    if t is torch.cos:
+        return ffmodel.cos(args[0])
+    if t is operator.neg:
+        return ffmodel.scalar_multiply(args[0], -1.0)
+    if t is torch.sum:
+        dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+        if dims is None:
+            raise NotImplementedError("full-reduce sum")
+        return ffmodel.reduce_sum(args[0], axes=_reduce_dims(dims),
+                                  keepdims=kwargs.get("keepdim", False))
+    if t in (torch.split, torch.chunk):
+        return _convert_split(ffmodel, args[0], args[1:], kwargs,
+                              is_chunk=(t is torch.chunk))
+    if t is torch.unsqueeze:
+        return _convert_unsqueeze(ffmodel, args[0], args[1:], kwargs)
+    if t is torch.squeeze:
+        return _convert_squeeze(ffmodel, args[0], args[1:], kwargs)
     raise NotImplementedError(f"torch function {t}")
+
+
+# ---- shared torch-semantics helpers (reference: SplitChunkNode — one node
+# class serves both x.split/x.chunk and the torch.* functions) ---------------
+def _reduce_dims(dims) -> list:
+    return [dims] if isinstance(dims, int) else list(dims)
+
+
+def _convert_split(ffmodel: FFModel, x, rest, kwargs, is_chunk: bool):
+    dim = kwargs.get("dim", rest[1] if len(rest) > 1 else 0)
+    total = x.dims[dim]
+    if is_chunk:
+        n = rest[0]
+        per = -(-total // n)  # torch.chunk: ceil division
+        sizes = []
+        left = total
+        while left > 0:
+            sizes.append(min(per, left))
+            left -= per
+    else:
+        sizes = rest[0]
+        if isinstance(sizes, int):
+            # torch.split: last chunk carries the remainder
+            per = sizes
+            sizes = [per] * (total // per)
+            if total % per:
+                sizes.append(total % per)
+    return tuple(ffmodel.split(x, list(sizes), axis=dim))
+
+
+def _convert_unsqueeze(ffmodel: FFModel, x, rest, kwargs):
+    dim = kwargs.get("dim", rest[0] if rest else None)
+    assert dim is not None, "unsqueeze requires a dim"
+    shape = list(x.dims)
+    a = dim if dim >= 0 else len(shape) + dim + 1
+    shape.insert(a, 1)
+    return ffmodel.reshape(x, shape)
+
+
+def _convert_squeeze(ffmodel: FFModel, x, rest, kwargs):
+    dim = kwargs.get("dim", rest[0] if rest else None)
+    shape = list(x.dims)
+    if dim is not None:
+        a = dim % len(shape)
+        if shape[a] == 1:
+            shape.pop(a)
+    else:
+        shape = [s for s in shape if s != 1] or [1]
+    return ffmodel.reshape(x, shape)
 
 
 def _np_dtype(torch_dtype):

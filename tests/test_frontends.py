@@ -145,3 +145,40 @@ def test_keras_exp_gated_on_tensorflow():
             _require_tf()
         with pytest.raises(ImportError):
             KerasExpModel(None)
+
+
+class TorchT5Block(torch.nn.Module):
+    """T5LayerNorm-style normalization + split/sum/unsqueeze coverage (the
+    reference coalesces T5LayerNorm because it lacked rsqrt/pow/mean nodes,
+    torch/model.py:2473-2494; here the chain traces natively)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(16, 32)
+
+    def forward(self, x):
+        h = self.fc(x)
+        var = h.pow(2).mean(-1, keepdim=True)
+        h = h * torch.rsqrt(var + 1e-6)           # T5LayerNorm core
+        a, b = h.chunk(2, dim=-1)                 # method chunk
+        s = torch.sum(a * b, 1, keepdim=True)     # function sum
+        return (h + s).squeeze(0).unsqueeze(0)    # squeeze/unsqueeze
+
+
+def test_torch_t5norm_alignment():
+    _align(TorchT5Block().eval(), (16,), atol=1e-4)
+
+
+class TorchRaggedSplit(torch.nn.Module):
+    """Non-divisible split/chunk + kwarg dims (torch remainder semantics)."""
+
+    def forward(self, x):  # x: (b, 10)
+        a, b, c, d = x.split(3, dim=1)          # [3,3,3,1]
+        e, f, g = torch.chunk(x, 3, dim=1)      # [4,4,2]
+        s = (a.sum(dim=1, keepdim=True) + d + g.sum(1, keepdim=True))
+        return s.squeeze(dim=1).unsqueeze(dim=1) + e.mean(dim=1,
+                                                          keepdim=True)
+
+
+def test_torch_ragged_split_alignment():
+    _align(TorchRaggedSplit().eval(), (10,), atol=1e-5)
