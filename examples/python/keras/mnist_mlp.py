@@ -20,9 +20,12 @@ def main(argv=None, num_samples=4096):
     y_train = np.reshape(y_train.astype("int32"),
                          (len(y_train), 1))[:num_samples]
 
+    from flexflow_tpu.frontends.keras import GlorotUniform, Zeros
+
     model = Sequential([
         Input(shape=(784,)),
-        Dense(512, activation="relu"),
+        Dense(512, activation="relu", kernel_initializer=GlorotUniform(123),
+              bias_initializer=Zeros()),
         Dropout(0.2),
         Dense(512, activation="relu"),
         Dropout(0.2),

@@ -17,6 +17,18 @@ class Initializer:
     def __call__(self, key, shape: Sequence[int], dtype):
         raise NotImplementedError
 
+    def _seeded(self, key):
+        """Mix the initializer's own seed into the executor-provided key so
+        two initializers with different seeds give different weights (the
+        reference seeds each initializer task with its own seed,
+        initializer.cc)."""
+        seed = getattr(self, "seed", 0)
+        if not seed:
+            return key
+        import jax
+
+        return jax.random.fold_in(key, seed)
+
 
 class GlorotUniformInitializer(Initializer):
     """Xavier/Glorot uniform (reference: initializer.cc GlorotUniform)."""
@@ -41,7 +53,8 @@ class GlorotUniformInitializer(Initializer):
 
         fan_in, fan_out = self._fans(tuple(shape))
         limit = float(np.sqrt(6.0 / max(fan_in + fan_out, 1)))
-        return jax.random.uniform(key, tuple(shape), dtype, -limit, limit)
+        return jax.random.uniform(self._seeded(key), tuple(shape), dtype,
+                                  -limit, limit)
 
 
 class ZeroInitializer(Initializer):
@@ -70,7 +83,7 @@ class UniformInitializer(Initializer):
     def __call__(self, key, shape, dtype):
         import jax
 
-        return jax.random.uniform(key, tuple(shape), dtype,
+        return jax.random.uniform(self._seeded(key), tuple(shape), dtype,
                                   self.min_val, self.max_val)
 
 
@@ -83,7 +96,8 @@ class NormInitializer(Initializer):
     def __call__(self, key, shape, dtype):
         import jax
 
-        return self.mean + self.stddev * jax.random.normal(key, tuple(shape), dtype)
+        return self.mean + self.stddev * jax.random.normal(
+            self._seeded(key), tuple(shape), dtype)
 
 
 DefaultWeightInitializer = GlorotUniformInitializer

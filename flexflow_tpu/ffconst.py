@@ -19,6 +19,14 @@ class ActiMode(enum.IntEnum):
     AC_MODE_GELU = 14
 
 
+class RegularizerMode(enum.IntEnum):
+    """reference: flexflow/type.py RegularizerMode."""
+
+    REG_MODE_NONE = 17
+    REG_MODE_L1 = 18
+    REG_MODE_L2 = 19
+
+
 class AggrMode(enum.IntEnum):
     """Embedding aggregation (reference: ffconst.h:18-22)."""
 

@@ -83,15 +83,26 @@ def InputTensor(shape, dtype="float32", name=None) -> _Node:
 
 class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
-                 name=None, **kw):
+                 kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None, name=None, **kw):
         super().__init__(name)
         self.units = units
         self.activation = activation
         self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
 
     def apply(self, ff, inputs):
+        from . import keras_initializers as KI
+        from . import keras_regularizers as KR
+
         return ff.dense(inputs[0], self.units, _ACTI_MAP[self.activation],
-                        self.use_bias, name=self.name)
+                        self.use_bias,
+                        kernel_initializer=KI.resolve(self.kernel_initializer),
+                        bias_initializer=KI.resolve(self.bias_initializer),
+                        kernel_regularizer=KR.resolve(self.kernel_regularizer),
+                        name=self.name)
 
 
 class Conv2D(Layer):
@@ -357,6 +368,11 @@ class Model(_BaseModel):
 # preprocessing}) exposed under the frontend namespace -------------------------
 from . import keras_callbacks as callbacks  # noqa: E402
 from . import keras_datasets as datasets  # noqa: E402
+from . import keras_initializers as initializers  # noqa: E402
 from . import keras_preprocessing as preprocessing  # noqa: E402
+from . import keras_regularizers as regularizers  # noqa: E402
 from .keras_callbacks import (Callback, EpochVerifyMetrics,  # noqa: E402
                               LearningRateScheduler, VerifyMetrics)
+from .keras_initializers import (GlorotUniform, RandomNormal,  # noqa: E402
+                                 RandomUniform, Zeros)
+from .keras_regularizers import L1, L2  # noqa: E402

@@ -505,7 +505,9 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         if t == "flatten":
             return ffmodel.flat(x)
         if t == "mean":
-            dims = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            if dims is None:
+                raise NotImplementedError("full-reduce mean")
             return ffmodel.mean(x, dims=_reduce_dims(dims),
                                 keepdims=kwargs.get("keepdim", False))
         if t == "sum":
@@ -629,8 +631,7 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
         if dims is None:
             raise NotImplementedError("full-reduce mean")
-        dims = [dims] if isinstance(dims, int) else list(dims)
-        return ffmodel.mean(args[0], dims=dims,
+        return ffmodel.mean(args[0], dims=_reduce_dims(dims),
                             keepdims=kwargs.get("keepdim", False))
     if t is F.dropout:
         return ffmodel.dropout(args[0], rate=kwargs.get("p", 0.5))

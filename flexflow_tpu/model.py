@@ -98,12 +98,16 @@ class FFModel:
               activation: ActiMode = ActiMode.AC_MODE_NONE,
               use_bias: bool = True, datatype: Optional[DataType] = None,
               kernel_initializer=None, bias_initializer=None,
+              kernel_regularizer=None,
               name: Optional[str] = None) -> Tensor:
+        """kernel_regularizer: ("l1"|"l2", lambda) weight-decay spec added to
+        the training loss (reference: RegularizerMode on Linear)."""
         return self._add_layer(
             OperatorType.OP_LINEAR, [input],
             {"out_dim": out_dim, "activation": activation, "use_bias": use_bias,
              "kernel_initializer": kernel_initializer,
-             "bias_initializer": bias_initializer},
+             "bias_initializer": bias_initializer,
+             "kernel_regularizer": kernel_regularizer},
             datatype or input.dtype, name)
 
     def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,

@@ -39,6 +39,24 @@ def apply_activation(x, activation: ActiMode):
     raise ValueError(f"unknown activation {activation}")
 
 
+def apply_weight_regularizer(spec, kernel, ctx: OpContext) -> None:
+    """("l1"|"l2", lambda) weight-decay penalty added to the training loss
+    via the aux-loss hook (reference: keras/regularizers.py carries the
+    RegularizerMode into the Linear layer)."""
+    if not spec or not ctx.training or ctx.aux_losses is None:
+        return
+    kind, lam = spec
+    import jax.numpy as jnp
+
+    w = kernel.astype(jnp.float32)
+    if kind == "l1":
+        ctx.aux_losses.append(lam * jnp.sum(jnp.abs(w)))
+    elif kind == "l2":
+        ctx.aux_losses.append(lam * jnp.sum(w * w))
+    else:
+        raise ValueError(f"unknown regularizer kind {kind!r}")
+
+
 @register_op(OperatorType.OP_LINEAR)
 class LinearOp(Op):
     """attrs: out_dim, activation, use_bias, kernel_initializer, bias_initializer."""
@@ -73,6 +91,8 @@ class LinearOp(Op):
         y = y.astype(x.dtype)
         if "bias" in params:
             y = y + params["bias"]
+        apply_weight_regularizer(self.attrs.get("kernel_regularizer"),
+                                 kernel, ctx)
         return [apply_activation(y, self.attrs.get("activation",
                                                    ActiMode.AC_MODE_NONE))]
 
