@@ -98,6 +98,9 @@ class FFConfig:
 
     # observability
     profiling: bool = False
+    # Legion Prof analog (-lg:prof / -lg:prof_logfile): when set, fit() runs
+    # under jax.profiler.trace writing an XLA/TensorBoard trace here
+    profiler_trace_dir: str = ""
     perform_auto_mapping: bool = False
     # numerical-safety checks — the TPU analog of the reference's reliance on
     # Legion region coherence for race freedom (SURVEY §5: XLA purity plays
@@ -215,6 +218,10 @@ class FFConfig:
                 self.device_memory_mb = int(_next())
             elif a in ("-ll:zsize", "-ll:util", "-ll:py", "-lg:prof"):
                 _next()  # accepted and ignored on TPU
+            elif a in ("--profiler-trace", "-lg:prof_logfile"):
+                # Legion Prof analog: dump a jax.profiler (XLA/TensorBoard)
+                # trace of the training loop to this directory
+                self.profiler_trace_dir = _next()
             elif a == "--seed":
                 self.seed = int(_next())
             elif a == "--mesh-shape":

@@ -1,3 +1,4 @@
 """Pallas TPU kernels for the hot ops (SURVEY §7: attention, softmax, top-k,
 MoE dispatch)."""
 from .flash_attention import flash_attention  # noqa: F401
+from .topk import pallas_topk  # noqa: F401

@@ -1,0 +1,29 @@
+"""Shared routing/tiling helpers for the row-wise Pallas kernels
+(softmax, top-k)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+DEFAULT_BLOCK_ROWS = 8
+
+
+def resolve_interpret(interpret: Optional[bool]) -> bool:
+    """Default to interpret mode off-TPU (the CPU test mesh)."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def pick_block_rows(rows: int, dim: int) -> int:
+    """Largest row block dividing ``rows`` whose f32 working set stays
+    within a conservative VMEM budget — wide rows otherwise OOM the 16 MiB
+    scoped vmem (observed at 64 x 32768 in the softmax backward, where
+    input + probs + grad tiles are live at once)."""
+    budget = 4 * 2 ** 20  # bytes per tile
+    cap = max(budget // max(dim * 4, 1), 1)
+    for b in (64, 32, 16, DEFAULT_BLOCK_ROWS, 4, 2, 1):
+        if b <= cap and rows % b == 0:
+            return b
+    return 1

@@ -22,7 +22,8 @@ from typing import Optional
 import jax
 import numpy as np
 
-DEFAULT_BLOCK_ROWS = 8
+from ._common import (DEFAULT_BLOCK_ROWS, pick_block_rows as _pick_block_rows,
+                      resolve_interpret as _resolve_interpret)
 
 
 def _softmax_fwd_kernel(x_ref, o_ref):
@@ -56,24 +57,6 @@ def _rowwise_call(kernel, args, rows: int, dim: int, out_dtype,
         out_shape=jax.ShapeDtypeStruct((rows, dim), out_dtype),
         interpret=interpret,
     )(*args)
-
-
-def _resolve_interpret(interpret: Optional[bool]) -> bool:
-    if interpret is not None:
-        return interpret
-    return jax.default_backend() != "tpu"
-
-
-def _pick_block_rows(rows: int, dim: int) -> int:
-    """Largest row block dividing ``rows`` whose f32 working set (input +
-    probs + grad tiles) stays within a conservative VMEM budget — wide rows
-    otherwise OOM the 16 MiB scoped vmem (observed at 64 x 32768)."""
-    budget = 4 * 2 ** 20  # bytes per tile, 3 tiles live in the bwd kernel
-    cap = max(budget // max(dim * 4, 1), 1)
-    for b in (64, 32, 16, DEFAULT_BLOCK_ROWS, 4, 2, 1):
-        if b <= cap and rows % b == 0:
-            return b
-    return 1
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -365,9 +365,13 @@ class FFModel:
         return self._unary(OperatorType.OP_REDUCE_SUM, x,
                            {"axes": list(axes), "keepdims": keepdims}, name)
 
-    def top_k(self, x, k: int, sorted: bool = True, name=None):
+    def top_k(self, x, k: int, sorted: bool = True, name=None,
+              use_pallas: bool = False):
+        # use_pallas AFTER name: positional reference-compat signature is
+        # top_k(input, k, sorted, name) (flexflow_cffi surface)
         return self._add_layer(OperatorType.OP_TOPK, [x],
-                               {"k": k, "sorted": sorted}, x.dtype, name)
+                               {"k": k, "sorted": sorted,
+                                "use_pallas": use_pallas}, x.dtype, name)
 
     # ---- MoE (reference: src/ops/moe.cc, group_by.cc, aggregate.cc) -----------
     def group_by(self, input: Tensor, assign: Tensor, n: int,
@@ -478,6 +482,9 @@ class FFModel:
             self.optimizer = SGDOptimizer(self)
         self.loss_type = loss_type
         self.metrics_obj = Metrics(loss_type, metrics or [])
+        # each compile decides afresh whether the export slot was consumed
+        # by a --search-num-* target-machine strategy
+        self._exported_search_target = False
 
         # -- create_operators_from_layers (model.cc:2785) -----------------------
         pcg = self.create_pcg()
@@ -534,7 +541,8 @@ class FFModel:
                                    mesh_shape=self.strategy.mesh_shape,
                                    axis_names=self.strategy.axis_names)
 
-        if self.config.export_strategy_file:
+        if self.config.export_strategy_file and \
+                not getattr(self, "_exported_search_target", False):
             with open(self.config.export_strategy_file, "w") as f:
                 f.write(self.strategy.to_json(pcg))
         if self.config.export_strategy_computation_graph_file:
@@ -621,6 +629,41 @@ class FFModel:
             from .search.unity import unity_search
         except ImportError:
             return data_parallel_strategy(pcg, n_dev)
+        # --search-num-nodes/--search-num-workers: search for a TARGET
+        # machine that may differ from the one we are running on (reference:
+        # graph.cc:1892-1897 overrides numNodes/workersPerNode for the
+        # search only — the export-strategy-for-a-bigger-machine workflow)
+        n_search = n_dev
+        if self.config.search_num_nodes > 0 or \
+                self.config.search_num_workers > 0:
+            nodes = (self.config.search_num_nodes
+                     if self.config.search_num_nodes > 0
+                     else self.config.num_nodes)
+            workers = (self.config.search_num_workers
+                       if self.config.search_num_workers > 0
+                       else max(self.config.workers_per_node, 1))
+            n_search = max(nodes * workers, 1)
+        if n_search != n_dev:
+            # searched strategy targets a different chip count: export it
+            # (that is what the flags are for), then run data-parallel on
+            # the machine we actually have. Without an export file the
+            # search would burn its whole budget producing nothing — skip.
+            if self.config.export_strategy_file:
+                target_pcg = pcg.copy()
+                strat = unity_search(target_pcg, self.config, n_search,
+                                     protected_guids=(self.final_guid,))
+                with open(self.config.export_strategy_file, "w") as f:
+                    f.write(strat.to_json(target_pcg))
+                self._exported_search_target = True
+            else:
+                import warnings
+
+                warnings.warn(
+                    "--search-num-nodes/--search-num-workers target "
+                    f"{n_search} devices but {n_dev} are available and no "
+                    "--export-strategy file is set; skipping the target "
+                    "search and running data-parallel")
+            return data_parallel_strategy(pcg, n_dev)
         # the final (loss-anchored) node must survive graph rewrites so the
         # label tensor and executor anchor stay valid (the reference protects
         # its sink the same way via the output-shape contract)
@@ -686,57 +729,66 @@ class FFModel:
         if self.config.profiling:
             self.profile_operators()
             t0 = time.time()  # per-op measurement must not skew THROUGHPUT
-        epoch = 0
-        while epoch < epochs:
-            # shuffled epochs by default (the reference's loaders shuffle);
-            # the shuffled path stages batches through the native C++
-            # double-buffered BatchPipeline (data/dataloader.py)
-            it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
-                                seed=self.config.numpy_seed() + epoch)
-            epoch_metrics = []  # device-side; folded at epoch end (async)
-            recompiled = False
-            for batch in prefetch_iterator(
-                    it, in_shardings + [label_sharding]):
-                bx, by = batch[:-1], batch[-1]
-                if cache is not None:
-                    (self.params, self.opt_state, loss_val, m,
-                     fresh) = step_fn(self.params, self.opt_state, bx, by,
-                                      self._next_rng(), cache)
-                    self._score_caches(cache, fresh, step_count)
-                    cache.update(fresh)
-                else:
-                    self.params, self.opt_state, loss_val, m = step_fn(
-                        self.params, self.opt_state, bx, by,
-                        self._next_rng())
-                epoch_metrics.append(m)
-                step_count += 1
-                if self._recompile_state is not None and \
-                        self.recompile_on_condition(self._recompile_state):
-                    # executor rebuilt: refresh the jitted step and cache,
-                    # then RE-RUN this epoch on the new shardings (the break
-                    # abandons the rest of its batches)
-                    step_fn = self.executor.make_train_step()
-                    cache = (self.executor.init_cache()
-                             if self.executor.cache_nodes else None)
-                    recompiled = True
-                    break
-                if self.config.profiling and \
-                        step_count % max(self.config.print_freq, 1) == 0:
-                    print(f"step {step_count}: loss={float(loss_val):.4f}")
-            # fold whatever the epoch produced (also the partial pre-recompile
-            # batches — their steps trained the old graph but still count)
-            for m in epoch_metrics:
-                self._perf.update({k: np.asarray(v) for k, v in m.items()})
-            if recompiled:
-                in_shardings = [self.executor.batch_sharding(a.ndim)
-                                for a in xs]
-                label_sharding = self.executor.batch_sharding(y.ndim)
-                continue  # restart the SAME epoch
-            if self.config.profiling:
-                print(f"epoch {epoch}: loss={float(loss_val):.4f}")
-            epoch += 1
-        if loss_val is not None:
-            jax.block_until_ready(loss_val)
+        # Legion Prof analog (-lg:prof_logfile): XLA trace of the whole loop,
+        # viewable in TensorBoard/Perfetto (SURVEY §5 tracing subsystem)
+        tracing = bool(self.config.profiler_trace_dir)
+        if tracing:
+            jax.profiler.start_trace(self.config.profiler_trace_dir)
+        try:
+            epoch = 0
+            while epoch < epochs:
+                # shuffled epochs by default (the reference's loaders shuffle);
+                # the shuffled path stages batches through the native C++
+                # double-buffered BatchPipeline (data/dataloader.py)
+                it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
+                                    seed=self.config.numpy_seed() + epoch)
+                epoch_metrics = []  # device-side; folded at epoch end (async)
+                recompiled = False
+                for batch in prefetch_iterator(
+                        it, in_shardings + [label_sharding]):
+                    bx, by = batch[:-1], batch[-1]
+                    if cache is not None:
+                        (self.params, self.opt_state, loss_val, m,
+                         fresh) = step_fn(self.params, self.opt_state, bx, by,
+                                          self._next_rng(), cache)
+                        self._score_caches(cache, fresh, step_count)
+                        cache.update(fresh)
+                    else:
+                        self.params, self.opt_state, loss_val, m = step_fn(
+                            self.params, self.opt_state, bx, by,
+                            self._next_rng())
+                    epoch_metrics.append(m)
+                    step_count += 1
+                    if self._recompile_state is not None and \
+                            self.recompile_on_condition(self._recompile_state):
+                        # executor rebuilt: refresh the jitted step and cache,
+                        # then RE-RUN this epoch on the new shardings (the break
+                        # abandons the rest of its batches)
+                        step_fn = self.executor.make_train_step()
+                        cache = (self.executor.init_cache()
+                                 if self.executor.cache_nodes else None)
+                        recompiled = True
+                        break
+                    if self.config.profiling and \
+                            step_count % max(self.config.print_freq, 1) == 0:
+                        print(f"step {step_count}: loss={float(loss_val):.4f}")
+                # fold whatever the epoch produced (also the partial pre-recompile
+                # batches — their steps trained the old graph but still count)
+                for m in epoch_metrics:
+                    self._perf.update({k: np.asarray(v) for k, v in m.items()})
+                if recompiled:
+                    in_shardings = [self.executor.batch_sharding(a.ndim)
+                                    for a in xs]
+                    label_sharding = self.executor.batch_sharding(y.ndim)
+                    continue  # restart the SAME epoch
+                if self.config.profiling:
+                    print(f"epoch {epoch}: loss={float(loss_val):.4f}")
+                epoch += 1
+            if loss_val is not None:
+                jax.block_until_ready(loss_val)
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
         elapsed = time.time() - t0
         self._last_fit_time = elapsed
         self._last_fit_samples = steps_per_epoch * batch_size * epochs

@@ -161,9 +161,11 @@ class MeanOp(ReduceMeanOp):
 
 @register_op(OperatorType.OP_TOPK)
 class TopKOp(Op):
-    """attrs: k, sorted. outputs: (values, indices) over last dim
-    (reference: src/ops/topk.cc:437, custom GPU kernel — here lax.top_k;
-    XLA's TPU sort covers the MoE routing shapes)."""
+    """attrs: k, sorted, use_pallas. outputs: (values, indices) over last dim
+    (reference: src/ops/topk.cc:437, custom GPU kernel — here lax.top_k by
+    default; XLA's TPU sort covers the MoE routing shapes. The dedicated
+    Pallas sweep kernel, kernels/topk.py, routes on explicit opt-in like the
+    softmax kernel)."""
 
     def infer_output_shapes(self, input_shapes):
         s = input_shapes[0]
@@ -177,7 +179,14 @@ class TopKOp(Op):
         import jax.lax as lax
 
         (x,) = inputs
-        values, indices = lax.top_k(x, self.attrs["k"])
+        k = self.attrs["k"]
+        from ..kernels.topk import pallas_topk, should_use_pallas_topk
+
+        if should_use_pallas_topk(x, k,
+                                  opt_in=self.attrs.get("use_pallas", False)):
+            values, indices = pallas_topk(x, k)
+        else:
+            values, indices = lax.top_k(x, k)
         return [values, indices]
 
 

@@ -563,16 +563,40 @@ def apply_all_matches(pcg: PCG, xfers,
     return g, applied
 
 
+def _segment_map(pcg: PCG, threshold: int) -> Dict[int, int]:
+    """guid -> rewrite-segment index: the graph is split at bottleneck nodes
+    into segments of at most ``threshold`` compute nodes where bottleneck
+    spacing allows (reference: GraphSearchHelper::find_split_node,
+    substitution.cc:2095 — graphs above base_optimize_threshold are split at
+    a post-dominator and optimized piecewise)."""
+    bns = set(pcg.bottlenecks())
+    seg: Dict[int, int] = {}
+    idx = 0
+    count = 0
+    for n in pcg.topo_order():
+        seg[n.guid] = idx
+        if n.op.op_type not in (OperatorType.OP_INPUT,
+                                OperatorType.OP_WEIGHT):
+            count += 1  # compute nodes only, matching compute_nodes()
+        if count >= threshold and n.guid in bns:
+            idx += 1
+            count = 0
+    return seg
+
+
 def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         batch: int, xfers, budget: int, alpha: float,
                         space: Optional[SearchSpace] = None,
                         lam: float = 1.0,
-                        protected_guids: Sequence[int] = ()
+                        protected_guids: Sequence[int] = (),
+                        split_threshold: int = 0
                         ) -> Tuple[PCG, Dict[int, OpSharding],
                                    Dict[int, str], float]:
     """The reference's base_optimize (substitution.cc:2229-2306): best-first
     search over GraphXfer applications, each candidate costed by the DP, with
-    alpha pruning and a budget on explored graphs."""
+    alpha pruning and a budget on explored graphs. Above ``split_threshold``
+    compute nodes, rewrites are confined to bottleneck-delimited segments —
+    the reference's recursive split at find_split_node."""
     assignment, states, t = dp_assign(pcg, sim, dp, tp, batch, space, lam)
     best = (pcg, assignment, states, t)
     if not xfers:
@@ -585,10 +609,15 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
         cost, _, g = heapq.heappop(heap)
         if cost > best[3] * alpha:
             continue  # prune (reference: substitution.cc:2288)
+        seg = (_segment_map(g, split_threshold) if split_threshold
+               and len(g.compute_nodes()) > split_threshold else None)
         for xfer in xfers:
             for match in xfer.find_matches(g):
                 if any(guid in protected_guids for guid in match.values()):
                     continue
+                if seg is not None and len(
+                        {seg.get(guid, -1) for guid in match.values()}) > 1:
+                    continue  # spans a split point
                 try:
                     g2 = xfer.apply(g, match)
                 except Exception:
@@ -675,7 +704,9 @@ def unity_search(pcg: PCG, config, n_dev: int,
             g, a, s, t = best_first_optimize(
                 base_pcg, sim, dp, tp, batch, xfers,
                 budget=max(budget // 4, 4), alpha=alpha, space=space,
-                lam=lam, protected_guids=protected_guids)
+                lam=lam, protected_guids=protected_guids,
+                split_threshold=getattr(config, "base_optimize_threshold",
+                                        0))
             _, mem = sim.simulate(g, a, s)
             _log.info("mesh dp=%d tp=%d lam=%.2f -> %.3f ms, %.1f MiB/chip",
                       dp, tp, lam, t * 1e3, mem / 2 ** 20)

@@ -162,3 +162,27 @@ def test_debug_nans_flag(rng):
             jax.block_until_ready(out)
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_profiler_trace_dir(tmp_path):
+    """-lg:prof_logfile / --profiler-trace: fit() runs under
+    jax.profiler.trace and leaves an XLA trace dump in the directory
+    (Legion Prof analog, SURVEY §5 tracing subsystem)."""
+    trace_dir = str(tmp_path / "prof")
+    config = FFConfig()
+    config.parse_args(["--profiler-trace", trace_dir])
+    assert config.profiler_trace_dir == trace_dir
+    config.batch_size = 8
+    ff = FFModel(config)
+    x_t = ff.create_tensor((8, 16))
+    t = ff.dense(x_t, 8, ActiMode.AC_MODE_RELU)
+    ff.dense(t, 4)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=16).astype(np.int32)
+    ff.fit(x, y, epochs=1)
+    dumped = []
+    for root, _dirs, files in os.walk(trace_dir):
+        dumped.extend(files)
+    assert dumped, "no trace files written"

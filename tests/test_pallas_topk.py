@@ -1,0 +1,85 @@
+"""Pallas top-k kernel (kernels/topk.py — SURVEY §7's top-k kernel;
+reference analog src/ops/kernels/topk_kernels.cu): values/indices vs
+jax.lax.top_k, value-gradient vs lax.top_k's vjp, selection gate."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.kernels.topk import pallas_topk, should_use_pallas_topk
+
+
+@pytest.mark.parametrize("shape,k", [((8, 128), 2), ((4, 16, 256), 4),
+                                     ((6, 512), 1)])
+def test_pallas_topk_matches_lax(shape, k):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    vals, idx = pallas_topk(x, k, interpret=True)
+    rvals, ridx = jax.lax.top_k(x, k)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(rvals),
+                               rtol=1e-6, atol=0)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
+
+
+def test_pallas_topk_ties_lowest_index():
+    x = jnp.asarray([[3.0, 7.0, 7.0, 1.0]] * 8)
+    x = jnp.pad(x, ((0, 0), (0, 124)), constant_values=-10.0)  # lane-align
+    _, idx = pallas_topk(x, 2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(idx[0]), [1, 2])
+
+
+def test_pallas_topk_value_gradient_matches():
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 128), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (8, 3), jnp.float32)
+
+    def loss_pallas(x):
+        vals, _ = pallas_topk(x, 3, interpret=True)
+        return jnp.sum(vals * w)
+
+    def loss_ref(x):
+        vals, _ = jax.lax.top_k(x, 3)
+        return jnp.sum(vals * w)
+
+    g1 = jax.grad(loss_pallas)(x)
+    g2 = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-6, atol=0)
+
+
+def test_pallas_topk_bf16():
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 128), jnp.bfloat16)
+    vals, idx = pallas_topk(x, 2, interpret=True)
+    rvals, ridx = jax.lax.top_k(x, 2)
+    assert vals.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
+    np.testing.assert_allclose(np.asarray(vals, dtype=np.float32),
+                               np.asarray(rvals, dtype=np.float32),
+                               rtol=2e-2, atol=0)
+
+
+def test_selection_gate():
+    x = jnp.zeros((64, 256))
+    assert not should_use_pallas_topk(x, 2)  # no opt-in
+    assert not should_use_pallas_topk(x, 16, opt_in=True)  # k too large
+    assert not should_use_pallas_topk(jnp.zeros((64, 100)), 2, opt_in=True)
+    expected = jax.devices()[0].platform == "tpu"
+    assert should_use_pallas_topk(x, 2, opt_in=True) == expected
+
+
+def test_topk_op_use_pallas_attr():
+    """TopKOp routes by the gate; on CPU it falls back to lax.top_k but the
+    attr is accepted end-to-end through the op layer."""
+    from flexflow_tpu.ops.base import OpContext
+    from flexflow_tpu.ops.tensor_ops import TopKOp
+
+    op = TopKOp("tk", {"k": 2, "use_pallas": True}, None, num_inputs=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 128))
+    vals, idx = op.forward({}, [x], OpContext(training=False))
+    rvals, ridx = jax.lax.top_k(x, 2)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(rvals))
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
+
+
+def test_selection_gate_rejects_int_dtypes():
+    xi = jnp.zeros((64, 256), jnp.int32)
+    assert not should_use_pallas_topk(xi, 2, opt_in=True)

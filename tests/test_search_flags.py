@@ -1,0 +1,86 @@
+"""The remaining reference search flags with behavior behind them:
+--search-num-nodes/--search-num-workers (search for a TARGET machine,
+graph.cc:1892-1897) and --base-optimize-threshold (split the rewrite search
+at bottlenecks, substitution.cc:2095 find_split_node)."""
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+
+
+def _mlp(config, batch=8, width=64, depth=4):
+    ff = FFModel(config)
+    x_t = ff.create_tensor((batch, width))
+    t = x_t
+    for _ in range(depth):
+        t = ff.dense(t, width, ActiMode.AC_MODE_RELU)
+    ff.dense(t, 8)
+    return ff
+
+
+def test_search_num_workers_targets_other_machine(tmp_path):
+    """Searching for a 16-chip target on an 8-device host exports a 16-chip
+    strategy and executes data-parallel on the real mesh."""
+    out = tmp_path / "target_strategy.json"
+    config = FFConfig()
+    config.parse_args(["--search-num-nodes", "2",
+                       "--search-num-workers", "8",
+                       "--export-strategy", str(out),
+                       "--budget", "8"])
+    assert config.search_num_nodes == 2
+    assert config.search_num_workers == 8
+    config.batch_size = 16
+    ff = _mlp(config, batch=16)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    exported = json.loads(out.read_text())
+    mesh = exported["mesh_shape"]
+    assert int(np.prod(mesh)) == 16, exported
+    # the executable strategy runs on the 8 real (virtual CPU) devices
+    import jax
+
+    assert int(np.prod(ff.strategy.mesh_shape)) == len(jax.devices())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 64)).astype(np.float32)
+    y = rng.integers(0, 8, size=16).astype(np.int32)
+    ff.fit(x, y, epochs=1)
+
+
+def test_segment_map_splits_at_bottlenecks():
+    from flexflow_tpu.search.unity import _segment_map
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = _mlp(config, depth=6)
+    pcg = ff.create_pcg()
+    seg = _segment_map(pcg, threshold=2)
+    n_segments = len(set(seg.values()))
+    assert n_segments >= 3  # a 7-dense chain splits at every 2nd bottleneck
+    # segment ids are monotone in topo order
+    order = [seg[n.guid] for n in pcg.topo_order()]
+    assert order == sorted(order)
+
+
+def test_base_optimize_threshold_still_finds_tp():
+    """Splitting must not break the DP result: the searched strategy on a
+    wide MLP still beats/equals plain DP in simulation with threshold 2."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+    from flexflow_tpu.search.unity import simulate_best, unity_search
+
+    config = FFConfig()
+    config.parse_args(["--base-optimize-threshold", "2", "--budget", "8"])
+    assert config.base_optimize_threshold == 2
+    config.batch_size = 16
+    ff = _mlp(config, batch=16, width=512, depth=4)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.detect(8)
+    res = unity_search(pcg.copy(), config, 8, machine=machine,
+                       return_result=True, insert_ir_nodes=False)
+    dp = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    sim = Simulator(machine)
+    t_dp = simulate_best(sim, pcg, dp, {})
+    assert res.sim_time <= t_dp * 1.001
