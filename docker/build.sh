@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Build the flexflow-tpu image (reference analog: docker/build.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+TAG="${1:-flexflow-tpu:latest}"
+docker build -f docker/Dockerfile -t "$TAG" .
+echo "built $TAG"

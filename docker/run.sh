@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Run the test suite (default CMD) or an arbitrary command in the image
+# (reference analog: docker/run.sh).
+set -euo pipefail
+TAG="${FLEXFLOW_TPU_IMAGE:-flexflow-tpu:latest}"
+docker run --rm -it "$TAG" "$@"

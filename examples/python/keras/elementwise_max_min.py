@@ -1,0 +1,50 @@
+"""Maximum/Minimum merge layers through the functional keras API
+(reference: examples/python/keras/elementwise_max_min.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Dense, Input, Maximum,  # noqa: E402
+                                          Minimum, Model)
+
+
+def _run(merge_cls, argv=None):
+    input0 = Input(shape=(32,))
+    input1 = Input(shape=(10,))
+    x0 = Dense(20, activation="relu")(input0)
+    x1 = Dense(20, activation="relu")(input1)
+    f0 = merge_cls()([x0, x1])
+    out = Dense(1)(f0)
+
+    model = Model([input0, input1], out)
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer={"class_name": "Adam",
+                             "config": {"learning_rate": 0.001}},
+                  loss="mean_squared_error",
+                  metrics=("mean_squared_error",))
+    n = model.ffconfig.batch_size * 4
+    rng = np.random.default_rng(0)
+    return model.fit(
+        x=[rng.standard_normal((n, 32)).astype(np.float32),
+           rng.standard_normal((n, 10)).astype(np.float32)],
+        y=rng.standard_normal((n, 1)).astype(np.float32),
+        epochs=2)
+
+
+def elementwise_max(argv=None):
+    return _run(Maximum, argv)
+
+
+def elementwise_min(argv=None):
+    return _run(Minimum, argv)
+
+
+if __name__ == "__main__":
+    elementwise_max(sys.argv[1:])
+    elementwise_min(sys.argv[1:])
+    print("elementwise max/min OK")

@@ -1,0 +1,53 @@
+"""Functional CIFAR-10 CNN with concatenated towers (reference:
+examples/python/keras/func_cifar10_cnn_concat.py — Concatenate merge of
+three conv towers, cifar10 loader, VerifyMetrics callback)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Activation, Conv2D, Dense,  # noqa: E402
+                                          Flatten, Input, MaxPooling2D,
+                                          Model, callbacks, concatenate,
+                                          datasets)
+
+
+def main(argv=None, num_samples=512):
+    (x_train, y_train), _ = datasets.cifar10.load_data(num_samples)
+    x_train = x_train.astype("float32") / 255
+    y_train = np.reshape(y_train.astype("int32"), (len(y_train), 1))
+
+    inp = Input(shape=(3, 32, 32))
+    towers = []
+    for _ in range(3):
+        t = Conv2D(32, (3, 3), padding="same", activation="relu")(inp)
+        towers.append(Conv2D(32, (3, 3), padding="same",
+                             activation="relu")(t))
+    t = concatenate(towers, axis=1)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(256, activation="relu")(t)
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+
+    model = Model(inp, out)
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    n = (len(x_train) // model.ffconfig.batch_size) * \
+        model.ffconfig.batch_size
+    perf = model.fit(x_train[:n], y_train[:n],
+                     epochs=model.ffconfig.epochs,
+                     callbacks=[callbacks.VerifyMetrics(0.0)])
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return model, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

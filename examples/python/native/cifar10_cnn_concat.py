@@ -1,0 +1,35 @@
+"""CIFAR-10 CNN with concatenated conv towers (reference:
+examples/python/native/cifar10_cnn_concat.py — three 32-filter towers
+concatenated on channels, then two 64-filter towers, pool, dense 512/10).
+Exercises Concat fan-in through compile + the search."""
+from _common import run  # noqa: E402  (sys.path set up by _common)
+from flexflow_tpu import ActiMode
+
+
+def _tower(ff, x, filters):
+    t = ff.conv2d(x, filters, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    return ff.conv2d(t, filters, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+
+
+def build(ff, batch_size=64):
+    x = ff.create_tensor((batch_size, 3, 32, 32), name="cifar_image")
+    t = ff.concat([_tower(ff, x, 32) for _ in range(3)], axis=1)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.concat([_tower(ff, t, 64) for _ in range(2)], axis=1)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    return x, ff.softmax(t)
+
+
+def main(argv=None):
+    return run(lambda ff: build(ff, ff.config.batch_size),
+               [(3, 32, 32)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,48 @@
+"""Gather op demo (reference: examples/python/native/demo_gather.py —
+dense -> gather along dim 1 by a neighbors index tensor, MSE loss,
+manual forward/backward/update loop on attached arrays)."""
+import numpy as np
+
+import _common  # noqa: F401  (sys.path setup)
+from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel, LossType,
+                          MetricsType, SGDOptimizer)
+
+
+def main(argv=None, iters=20):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    bs = config.batch_size
+    ff = FFModel(config)
+    neighbors = np.array([[[0], [5], [3], [3], [7], [9]]])
+    neighbors = neighbors.repeat(bs, 0).repeat(5, 2).astype(np.int32)
+    x = np.full((bs, 16, 5), 0.01, np.float32)
+
+    input = ff.create_tensor((bs, 16, 5), DataType.DT_FLOAT)
+    index = ff.create_tensor((bs, 6, 5), DataType.DT_INT32)
+    x0 = ff.dense(input, 5, ActiMode.AC_MODE_NONE, False)
+    ff.gather(x0, index, 1)
+
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    ff.init_layers()
+    input.attach_numpy_array(ff, config, x)
+    index.attach_numpy_array(ff, config, neighbors)
+    y = np.random.default_rng(0).random((bs, 6, 5)).astype(np.float32)
+    ff.label_tensor.attach_numpy_array(ff, config, y)
+
+    losses = []
+    for _ in range(iters):
+        ff.forward()
+        ff.backward()
+        losses.append(float(ff._staged["loss"]))
+        ff.update()
+    print(f"gather demo: loss {losses[0]:.5f} -> {losses[-1]:.5f}")
+    assert losses[-1] < losses[0]
+    return ff
+
+
+if __name__ == "__main__":
+    print("Demo Gather")
+    main()

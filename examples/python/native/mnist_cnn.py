@@ -1,0 +1,26 @@
+"""MNIST CNN (reference: examples/python/native/mnist_cnn.py —
+conv 32/64 3x3 + pool + dense 128/10, SGD, sparse-CCE)."""
+from _common import run  # noqa: E402  (sys.path set up by _common)
+from flexflow_tpu import ActiMode
+
+
+def build(ff, batch_size=64):
+    x = ff.create_tensor((batch_size, 1, 28, 28), name="mnist_image")
+    t = ff.conv2d(x, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 128, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    return x, ff.softmax(t)
+
+
+def main(argv=None):
+    return run(lambda ff: build(ff, ff.config.batch_size),
+               [(1, 28, 28)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,72 @@
+"""MNIST MLP driven by the attach-style manual loop (reference:
+examples/python/native/mnist_mlp_attach.py — per-batch
+``tensor.set_tensor`` staging + explicit forward / zero_gradients /
+backward / update phases instead of fit())."""
+import numpy as np
+
+import _common  # noqa: F401  (sys.path setup)
+from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel, LossType,
+                          MetricsType, SGDOptimizer)
+
+
+def next_batch(idx, x_train, input_tensor, config, ff):
+    start = idx * config.batch_size
+    ff_batch = x_train[start:start + config.batch_size]
+    input_tensor.set_tensor(ff, ff_batch)
+
+
+def main(argv=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    b = config.batch_size
+    ff = FFModel(config)
+    input_tensor = ff.create_tensor((b, 784), DataType.DT_FLOAT)
+
+    t = ff.dense(input_tensor, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    ff.softmax(t)
+
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    label_tensor = ff.label_tensor
+
+    # synthetic linearly-separable stand-in for the mnist arrays
+    rng = np.random.default_rng(0)
+    num_samples = b * 8
+    x_train = rng.normal(size=(num_samples, 784)).astype(np.float32)
+    w = rng.normal(size=(784, 10)).astype(np.float32)
+    y_train = np.argmax(x_train @ w, axis=1).astype(np.int32)[:, None]
+
+    ff.init_layers()
+    ts_start = config.get_current_time()
+    for epoch in range(config.epochs):
+        ff.reset_metrics()
+        for it in range(num_samples // b):
+            next_batch(it, x_train, input_tensor, config, ff)
+            next_batch(it, y_train, label_tensor, config, ff)
+            ff.forward()
+            ff.zero_gradients()
+            ff.backward()
+            ff.update()
+    run_time = 1e-6 * (config.get_current_time() - ts_start)
+    print(f"epochs {config.epochs}, ELAPSED TIME = {run_time:.4f}s, "
+          f"THROUGHPUT = {num_samples * config.epochs / run_time:.2f} "
+          "samples/s")
+
+    # host readback of a staged tensor and a trained weight (the attach
+    # example tail prints both via inline_map/get_array)
+    label_tensor.inline_map(ff, config)
+    print("label batch:", label_tensor.get_array(ff, config).shape)
+    label_tensor.inline_unmap(ff, config)
+    dense1 = ff.get_layer_by_id(0)
+    print("dense1 kernel:", dense1.get_weight_tensor().get_weights(ff).shape)
+    return ff
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,53 @@
+"""Graph/tensor introspection demo (reference:
+examples/python/native/print_layers.py + print_weight.py + print_input.py —
+build a small net, map tensors host-side, print shapes/arrays, poke
+weights via set_weights)."""
+import numpy as np
+
+import _common  # noqa: F401  (sys.path setup)
+from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel, LossType,
+                          SGDOptimizer)
+
+
+def main(argv=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    b = config.batch_size
+    ff = FFModel(config)
+    input1 = ff.create_tensor((b, 3, 229, 229), DataType.DT_FLOAT)
+    input2 = ff.create_tensor((b, 16), DataType.DT_FLOAT)
+
+    t1 = ff.conv2d(input1, 64, 11, 11, 4, 4, 2, 2)
+    t2 = ff.dense(input2, 8, ActiMode.AC_MODE_RELU)
+    ff.concat([ff.flat(t1), t2], axis=1)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    label = ff.label_tensor
+
+    for lid, layer in ff.get_layers().items():
+        print(f"layer {lid}: {layer}")
+        for w in layer.weights:
+            print(f"   weight {w.name}: dims={w.get_dims()} "
+                  f"volume={w.get_volume()}")
+
+    # label host access (print_layers.py tail): map, read, unmap
+    label.inline_map(ff, config)
+    label_array = label.get_array(ff, config)
+    print("label:", label_array.shape, label_array.dtype)
+    label.inline_unmap(ff, config)
+
+    # weight poke (print_weight.py): conv kernel via global parameter id
+    conv_w = ff.get_tensor_by_id(0)
+    arr = np.full(conv_w.get_dims(), 1.2, dtype=np.float32)
+    conv_w.set_weights(ff, arr)
+    back = conv_w.get_weights(ff)
+    print("conv kernel after set:", back.shape, float(back.ravel()[0]))
+    assert np.allclose(back, 1.2)
+    return ff
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -1,0 +1,33 @@
+"""Concat + Split demo net (reference: examples/python/native/split.py —
+three conv towers concatenated on channels, split back into 3, middle
+branch trained). Exercises multi-output Split through compile/search."""
+from _common import run  # noqa: E402  (sys.path set up by _common)
+from flexflow_tpu import ActiMode
+
+
+def build(ff, batch_size=64):
+    x = ff.create_tensor((batch_size, 3, 32, 32), name="split_input")
+    towers = [ff.conv2d(x, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+              for _ in range(3)]
+    t = ff.concat(towers, axis=1)
+    ts = ff.split(t, 3, axis=1)
+    t = ff.conv2d(ts[1], 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    return x, ff.softmax(t)
+
+
+def main(argv=None):
+    return run(lambda ff: build(ff, ff.config.batch_size),
+               [(3, 32, 32)], 10, argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

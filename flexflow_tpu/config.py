@@ -230,6 +230,13 @@ class FFConfig:
             i += 1
 
     # -- derived properties -----------------------------------------------------
+    def get_current_time(self) -> float:
+        """Microsecond wall clock (reference: flexflow_cffi.py:559, the
+        Realm timer the examples use for ELAPSED TIME prints)."""
+        import time
+
+        return time.perf_counter() * 1e6
+
     @property
     def total_workers(self) -> int:
         return self.num_nodes * self.workers_per_node

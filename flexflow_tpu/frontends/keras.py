@@ -50,10 +50,15 @@ class Layer:
         self.name = name or f"{type(self).__name__.lower()}_{type(self).name_counter}"
 
     def __call__(self, prev):
-        """Functional composition: returns a _Node."""
+        """Functional composition: returns a _Node. A raw ``Input`` layer is
+        accepted where a node is expected (the reference keras examples write
+        ``Dense(...)(input0)`` with input0 = Input(shape=...))."""
+        def as_node(p):
+            return _Node(p, []) if isinstance(p, Input) else p
+
         if isinstance(prev, (list, tuple)):
-            return _Node(self, list(prev))
-        return _Node(self, [prev])
+            return _Node(self, [as_node(p) for p in prev])
+        return _Node(self, [as_node(prev)])
 
     def apply(self, ff: FFModel, inputs):
         raise NotImplementedError
@@ -241,6 +246,37 @@ class Multiply(Layer):
         return ff.multiply(inputs[0], inputs[1], name=self.name)
 
 
+class Maximum(Layer):
+    """reference: examples/python/keras/elementwise_max_min.py."""
+
+    def apply(self, ff, inputs):
+        return ff.max(inputs[0], inputs[1], name=self.name)
+
+
+class Minimum(Layer):
+    def apply(self, ff, inputs):
+        return ff.min(inputs[0], inputs[1], name=self.name)
+
+
+class Reshape(Layer):
+    """target_shape excludes the batch dim (keras contract; reference:
+    python/flexflow/keras/layers/core.py Reshape)."""
+
+    def __init__(self, target_shape, name=None):
+        super().__init__(name)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def apply(self, ff, inputs):
+        batch = inputs[0].dims[0]
+        return ff.reshape(inputs[0], (batch,) + self.target_shape,
+                          name=self.name)
+
+
+def concatenate(tensors, axis: int = 1, name=None):
+    """Functional alias (reference: keras layers concatenate())."""
+    return Concatenate(axis=axis, name=name)(tensors)
+
+
 # --------------------------------------------------------------------- models
 class _BaseModel:
     """reference: python/flexflow/keras/models/base_model.py."""
@@ -346,8 +382,13 @@ class Model(_BaseModel):
         built: Dict[int, Any] = {}
 
         def build_node(node: _Node):
-            if id(node) in built:
-                return built[id(node)]
+            # Input tensors key by the LAYER: the same Input may be wrapped
+            # in several _Node shells (one per consumer call) and must build
+            # exactly one graph input
+            key = (id(node.layer) if isinstance(node.layer, Input)
+                   else id(node))
+            if key in built:
+                return built[key]
             if isinstance(node.layer, Input):
                 inp = node.layer
                 dtype = DataType.DT_INT32 if "int" in inp.dtype else \
@@ -357,9 +398,13 @@ class Model(_BaseModel):
             else:
                 ins = [build_node(i) for i in node.inputs]
                 t = node.layer.apply(ff, ins)
-            built[id(node)] = t
+            built[key] = t
             return t
 
+        # declared input order fixes the fit(x=[...]) binding order,
+        # independent of output-traversal order
+        for inp in self.inputs:
+            build_node(inp if isinstance(inp, _Node) else _Node(inp, []))
         for out in self.outputs:
             build_node(out)
 

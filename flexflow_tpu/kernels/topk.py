@@ -38,12 +38,20 @@ def _topk_kernel(k: int, x_ref, vals_ref, idx_ref):
     x = x_ref[...].astype(jnp.float32)  # (block_rows, dim)
     cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     neg_inf = jnp.float32(-np.inf)
+    # selection key clamps -inf inputs to -FLT_MAX so -inf stays reserved
+    # for "already taken": rows with fewer than k finite entries must still
+    # return k DISTINCT indices (the lax.top_k contract; MoE routers mask
+    # logits with -inf, so this path is live). A genuine -FLT_MAX input
+    # ties with masked -inf entries — resolved by lowest index like any tie.
+    key = jnp.maximum(x, jnp.float32(np.finfo(np.float32).min))
     for j in range(k):  # unrolled: k is static and small
-        m = jnp.max(x, axis=-1)  # (block_rows,)
-        i = jnp.argmax(x, axis=-1).astype(jnp.int32)
-        vals_ref[:, j] = m.astype(vals_ref.dtype)
+        i = jnp.argmax(key, axis=-1).astype(jnp.int32)
+        sel = cols == i[:, None]
+        # original value at i (not the clamped key): x[row, i]
+        vals_ref[:, j] = jnp.max(jnp.where(sel, x, neg_inf),
+                                 axis=-1).astype(vals_ref.dtype)
         idx_ref[:, j] = i
-        x = jnp.where(cols == i[:, None], neg_inf, x)
+        key = jnp.where(sel, neg_inf, key)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))

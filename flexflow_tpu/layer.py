@@ -54,5 +54,20 @@ class Layer:
     def get_parameter_by_id(self, idx: int) -> Tensor:
         return self.weights[idx]
 
+    # named accessors (reference: flexflow_cffi.py Linear/Conv2D layer
+    # wrappers :175-215 — get_weight/bias/input/output_tensor)
+    def get_weight_tensor(self) -> Tensor:
+        return self.weights[0]
+
+    def get_bias_tensor(self) -> Tensor:
+        assert len(self.weights) > 1, f"{self.name} has no bias"
+        return self.weights[1]
+
+    def get_input_tensor(self, idx: int = 0) -> Tensor:
+        return self.inputs[idx]
+
+    def get_output_tensor(self, idx: int = 0) -> Tensor:
+        return self.outputs[idx]
+
     def __repr__(self) -> str:
         return f"Layer({self.name}, {self.op_type.name}, in={[t.name for t in self.inputs]})"

@@ -522,7 +522,12 @@ class FFModel:
             self.mesh = build_mesh(self.config,
                                    mesh_shape=self.strategy.mesh_shape,
                                    axis_names=self.strategy.axis_names)
-        elif self.config.only_data_parallel or n_dev == 1:
+        elif self.config.only_data_parallel or (
+                n_dev == 1 and not (self.config.search_num_nodes > 0
+                                    or self.config.search_num_workers > 0)):
+            # --search-num-* must still reach _run_search on a 1-device host:
+            # exporting a strategy for a bigger target machine from a small
+            # one is the flags' whole workflow (graph.cc:1892-1897)
             if self.config.mesh_shape:
                 # honor an explicit user mesh: batch shards over the first axis
                 self.mesh = build_mesh(self.config)
@@ -829,9 +834,13 @@ class FFModel:
     def init_operators(self) -> None:
         pass  # op state is created lazily by jit; kept for API parity
 
+    def init_layers(self) -> None:
+        pass  # reference name (flexflow_cffi.py init_layers); same no-op
+
     def forward(self, seq_length: Optional[int] = None) -> None:
+        self._ensure_staged_batch()
         assert self._staged.get("batch") is not None, \
-            "bind a batch first via next_batch/set_batch"
+            "bind a batch first via next_batch/set_batch/set_tensor"
         fwd = self.executor.make_forward()
         xs, _ = self._staged["batch"]
         self._staged["logits"] = fwd(self.params, xs)
@@ -842,6 +851,7 @@ class FFModel:
     def backward(self, seq_length: Optional[int] = None) -> None:
         import jax
 
+        self._ensure_staged_batch()
         xs, y = self._staged["batch"]
 
         from .ops.base import OpContext
@@ -868,6 +878,46 @@ class FFModel:
 
         xs = [jax.device_put(np.asarray(a)) for a in self._as_input_list(x)]
         self._staged["batch"] = (xs, jax.device_put(self._prep_label(y)))
+
+    def _stage_tensor_value(self, tensor, np_array) -> None:
+        """Tensor.set_tensor host staging (reference:
+        ParallelTensorBase::set_tensor, parallel_tensor.cc:698). Staging only
+        marks the batch dirty; composition + device_put happen lazily in the
+        next forward/backward so the attach loop's set_tensor(input) +
+        set_tensor(label) pair costs ONE host->device transfer per batch."""
+        per = self._staged.setdefault("per_tensor", {})
+        per[tensor.guid] = np.asarray(np_array)
+        self._staged["per_tensor_dirty"] = True
+
+    def _ensure_staged_batch(self) -> None:
+        if not self._staged.get("per_tensor_dirty"):
+            return
+        per = self._staged.get("per_tensor", {})
+        if not all(t.guid in per for t in self._input_tensors):
+            return  # forward() will assert if nothing was ever bound
+        xs = [per[t.guid] for t in self._input_tensors]
+        if self.label_tensor is not None and self.label_tensor.guid in per:
+            y = per[self.label_tensor.guid]
+        elif self.label_tensor is not None:
+            y = np.zeros(self.label_tensor.dims,
+                         dtype=dtype_to_jnp(self.label_tensor.dtype))
+        else:
+            return
+        self.set_batch(xs, y)
+        self._staged["per_tensor_dirty"] = False
+
+    def _staged_tensor_value(self, tensor) -> np.ndarray:
+        per = self._staged.get("per_tensor", {})
+        if tensor.guid in per:
+            return np.asarray(per[tensor.guid])
+        if self.label_tensor is not None and tensor is self.label_tensor:
+            return np.zeros(self.label_tensor.dims,
+                            dtype=dtype_to_jnp(self.label_tensor.dtype))
+        raise KeyError(f"{tensor.name}: no value staged; call set_tensor")
+
+    def reset_metrics(self) -> None:
+        """reference: flexflow_cffi.py:1968."""
+        self._perf = PerfMetrics()
 
     # ---- recompilation (reference: RecompileState, model.cc:2422) -------------
     def profile_operators(self, max_ops: int = 8) -> None:
@@ -964,6 +1014,12 @@ class FFModel:
             if l.name == name:
                 return l
         return None
+
+    def get_tensor_by_id(self, id: int) -> Tensor:
+        """Weight tensors in declaration order (reference:
+        flexflow_cffi.py:2179 — parameter id over the whole model)."""
+        weights = [w for l in self._layers for w in l.weights]
+        return weights[id]
 
     def get_perf_metrics(self) -> PerfMetrics:
         return self._perf

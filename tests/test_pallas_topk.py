@@ -83,3 +83,19 @@ def test_topk_op_use_pallas_attr():
 def test_selection_gate_rejects_int_dtypes():
     xi = jnp.zeros((64, 256), jnp.int32)
     assert not should_use_pallas_topk(xi, 2, opt_in=True)
+
+
+def test_pallas_topk_distinct_indices_with_inf_mask():
+    """Rows with fewer than k finite entries still return k DISTINCT
+    indices (lax.top_k contract; MoE routers mask logits with -inf)."""
+    row = np.full((8, 128), -np.inf, np.float32)
+    row[:, 5] = 1.0  # single finite entry
+    x = jnp.asarray(row)
+    vals, idx = pallas_topk(x, 3, interpret=True)
+    rvals, ridx = jax.lax.top_k(x, 3)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
+    np.testing.assert_array_equal(np.asarray(vals), np.asarray(rvals))
+    # gradient scatters once per distinct index
+    g = jax.grad(lambda x: jnp.sum(pallas_topk(x, 3, interpret=True)[0]
+                                   * jnp.asarray([1.0, 10.0, 100.0])))(x)
+    assert float(g[0, 5]) == 1.0
