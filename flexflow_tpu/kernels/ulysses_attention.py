@@ -1,0 +1,72 @@
+"""All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+The second sequence-parallel schedule next to ring attention
+(kernels/ring_attention.py). No reference analog (SURVEY §5: the reference
+scales sequence only via head/sample sharding) — part of the long-context
+extension. Inputs arrive sequence-sharded; two ``lax.all_to_all``s
+re-partition (b, h, s/P, d) -> (b, h/P, s, d) so every chip computes FULL
+attention for its head group, then the output transposes back. Comm is 4
+all-to-alls of the activation volume regardless of P, vs ring's (P-1) k/v
+rotations — cheaper for large P / short-ish sequences, while ring keeps the
+O((s/P)^2) score-memory advantage for extreme context. Requires
+heads % P == 0.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _full_attn(q, k, v, causal: bool):
+    """Full softmax attention in f32: q,k,v (b, h, s, d)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def ulysses_attention(q, k, v, mesh, seq_axis: str = "seq",
+                      causal: bool = False,
+                      data_axis: Optional[str] = "data"):
+    """q,k,v: (batch, heads, seq, head_dim), seq sharded over ``seq_axis``.
+
+    Must be called under jit with ``mesh``; returns the attention output
+    with the same sharding as q."""
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_seq = mesh.shape[seq_axis]
+    heads = q.shape[1]
+    assert heads % n_seq == 0, \
+        f"ulysses needs heads ({heads}) divisible by |{seq_axis}| ({n_seq})"
+    batch_spec = data_axis if (data_axis and data_axis in mesh.shape) else None
+    spec = P(batch_spec, None, seq_axis, None)
+
+    def local(q_blk, k_blk, v_blk):
+        # (b, h, s/P, d) -> (b, h/P, s, d): each chip now owns h/P full-
+        # sequence heads
+        def fwd(x):
+            return lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        out = _full_attn(fwd(q_blk), fwd(k_blk), fwd(v_blk), causal)
+        # (b, h/P, s, d) -> (b, h, s/P, d)
+        out = lax.all_to_all(out, seq_axis, split_axis=2, concat_axis=1,
+                             tiled=True)
+        return out.astype(q_blk.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

@@ -112,10 +112,16 @@ class MultiHeadAttentionOp(Op):
         seq_axis = self.attrs.get("sequence_parallel_axis")
         dropout = self.attrs.get("dropout", 0.0)
         if seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
-            from ..kernels.ring_attention import ring_attention
+            if self.attrs.get("sequence_parallel_mode") == "alltoall":
+                from ..kernels.ulysses_attention import ulysses_attention
 
-            out = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
-                                 causal=causal)
+                out = ulysses_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
+                                        causal=causal)
+            else:  # default schedule: ring rotation over ICI
+                from ..kernels.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
+                                     causal=causal)
         elif (dropout == 0.0 or not ctx.training) \
                 and _should_use_flash(use_flash, q, k, causal) \
                 and _flash_blocks(q.shape[-2], k.shape[-2]) is not None:

@@ -122,11 +122,17 @@ def _transitive_producer(pcg: PCG, node) -> Optional[int]:
 
 def long_context_strategy(pcg: PCG, dp: int, sp: int,
                           data_axis: str = "data",
-                          seq_axis: str = "seq") -> Strategy:
+                          seq_axis: str = "seq",
+                          mode: str = "ring") -> Strategy:
     """Sequence/context parallelism: activations sharded over the seq dim,
-    attention computed with ring attention over the ``seq`` mesh axis
-    (kernels/ring_attention.py). No reference analog (SURVEY §5) — the
-    long-context extension the reference lacks."""
+    attention computed over the ``seq`` mesh axis with one of two schedules
+    — ``mode="ring"`` (k/v rotation, kernels/ring_attention.py, O((s/P)^2)
+    score memory) or ``mode="alltoall"`` (Ulysses head re-partition,
+    kernels/ulysses_attention.py, 4 all-to-alls; needs heads % sp == 0).
+    No reference analog (SURVEY §5) — the long-context extension the
+    reference lacks."""
+    assert mode in ("ring", "alltoall"), \
+        f"mode must be 'ring' or 'alltoall', got {mode!r}"
     s = Strategy(mesh_shape=(dp, sp), axis_names=(data_axis, seq_axis),
                  data_axis=data_axis)
     view = MachineView(dim=(dp, sp), stride=(sp, 1))
@@ -136,6 +142,8 @@ def long_context_strategy(pcg: PCG, dp: int, sp: int,
         ot = node.op.op_type
         if ot == OperatorType.OP_MULTIHEAD_ATTENTION:
             ns.extra["sequence_parallel_axis"] = seq_axis
+            if mode != "ring":
+                ns.extra["sequence_parallel_mode"] = mode
             # output stays seq-sharded: (batch, seq, hidden)
             ns.output_spec = (data_axis, seq_axis, None)
         elif len(node.out_shapes[0]) >= 3 and \

@@ -76,6 +76,38 @@ class OpSharding:
         return self.dp * (self.tp if self.kind != "none" else self.act_tp)
 
 
+def sequence_schedule(node: PCGNode, in_shapes, sh: "OpSharding",
+                      machine) -> Tuple[str, float]:
+    """Pick the sequence-parallel schedule for a ring-kind attention op and
+    return (schedule, comm_time): "ring" (k/v rotation,
+    kernels/ring_attention.py) or "alltoall" (Ulysses head re-partition,
+    kernels/ulysses_attention.py). All-to-all moves ~P/2x less data but
+    materializes the full (s, s) score block per local head group, so it is
+    eligible only when the head count divides the axis AND that block fits
+    comfortably in HBM (<= 1/8 capacity) — long-context configs keep ring's
+    O((s/P)^2) memory. Both ``Simulator.op_cost`` and the strategy emission
+    (unity.assignment_to_strategy) use THIS function, so the search's costs
+    always match the executed schedule."""
+    el = size_of_datatype(node.op.data_type)
+    in_bytes = sum(int(np.prod(s)) for s in in_shapes) * el
+    deg = max(sh.degree, 1)
+    # k+v are 2 of the 3 equally-sized self-attention inputs
+    kv_per_chip = int(2 * in_bytes / 3) // deg
+    ring_t = machine.allgather_time(kv_per_chip, sh.tp)
+    heads = node.op.attrs.get("num_heads", 0)
+    if not heads or heads % sh.tp != 0:
+        return "ring", ring_t
+    b, s = in_shapes[0][0], in_shapes[0][1]
+    score_bytes = (b / max(sh.dp, 1)) * (heads / sh.tp) * s * s * 4  # f32
+    if score_bytes > machine.hbm_capacity / 8:
+        return "ring", ring_t
+    # 4 all-to-alls (q, k, v in; out back) of the local activation volume
+    aa_t = 4 * machine.alltoall_time(int(in_bytes / 3) // deg, sh.tp)
+    if aa_t < ring_t:
+        return "alltoall", aa_t
+    return "ring", ring_t
+
+
 class Simulator:
     def __init__(self, machine: TPUMachineModel,
                  overlap_backward_update: bool = False):
@@ -125,11 +157,10 @@ class Simulator:
         if sh.kind in ("row", "heads", "table") and sh.tp > 1:
             comm = m.allreduce_time(out_bytes // max(sh.dp, 1), sh.tp)
         elif sh.kind == "ring" and sh.tp > 1:
-            # ring attention (sequence parallel): (tp-1) rounds passing the
-            # local k/v shards around the ring (kernels/ring_attention.py);
-            # k+v are 2 of the 3 equally-sized self-attention inputs
-            kv_per_chip = int(2 * in_bytes / 3) // deg
-            comm = m.allgather_time(kv_per_chip, sh.tp)
+            # sequence parallel: cost the schedule the emission will pick
+            # (ring k/v rotation or all-to-all head re-partition) so the
+            # DP's numbers match the executed program
+            _, comm = sequence_schedule(node, in_shapes, sh, m)
         elif sh.kind == "expert" and sh.tp > 1:
             # expert parallel: all-to-all token exchange in and out
             comm = 2 * m.alltoall_time(in_bytes // deg, sh.tp)

@@ -323,9 +323,13 @@ def simulate_best(sim: Simulator, pcg: PCG,
 def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                            states: Dict[int, str], dp: int, tp: int,
                            data_axis: str = "data",
-                           model_axis: str = "model") -> Strategy:
+                           model_axis: str = "model",
+                           machine: Optional[TPUMachineModel] = None
+                           ) -> Strategy:
     """Materialize the search result as weight/output shardings (the
-    reference's convert_graph_to_operators + optimal_views)."""
+    reference's convert_graph_to_operators + optimal_views). ``machine``
+    enables sequence-schedule selection (ring vs alltoall) consistent with
+    the simulator's costs; without it the ring schedule is kept."""
     if tp == 1:
         s = Strategy(mesh_shape=(dp,), axis_names=(data_axis,),
                      data_axis=data_axis)
@@ -378,6 +382,18 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                 ns.output_spec = state_spec("R", ndim)
             elif sh.kind == "ring":
                 ns.extra["sequence_parallel_axis"] = model_axis
+                if machine is not None:
+                    # the SAME rule the simulator costed with
+                    # (simulator.sequence_schedule): alltoall only when
+                    # cheaper on comm AND its (s, s) score block fits HBM
+                    from .simulator import sequence_schedule
+
+                    in_shapes = [pcg.nodes[g].out_shapes[i]
+                                 for g, i in node.inputs]
+                    sched, _ = sequence_schedule(node, in_shapes, sh,
+                                                 machine)
+                    if sched != "ring":
+                        ns.extra["sequence_parallel_mode"] = sched
                 ns.output_spec = state_spec("Q", ndim)
         elif ot == OperatorType.OP_EMBEDDING:
             ns.weight_specs = {"weight": (model_axis, None)}
@@ -711,7 +727,8 @@ def unity_search(pcg: PCG, config, n_dev: int,
             _log.info("mesh dp=%d tp=%d lam=%.2f -> %.3f ms, %.1f MiB/chip",
                       dp, tp, lam, t * 1e3, mem / 2 ** 20)
             results.append(SearchResult(
-                strategy=assignment_to_strategy(g, a, s, dp, tp),
+                strategy=assignment_to_strategy(g, a, s, dp, tp,
+                                                machine=machine),
                 assignment=a, sim_time=t, sim_memory=mem,
                 mesh_shape=(dp, tp), pcg=g, states=s))
         if not results:
@@ -819,4 +836,4 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
             if t < best_t:
                 best, best_t = dict(cand), t
     states = {n.guid: "R" for n in nodes}
-    return assignment_to_strategy(pcg, best, states, dp, tp)
+    return assignment_to_strategy(pcg, best, states, dp, tp, machine=machine)

@@ -1,0 +1,189 @@
+"""All-to-all (Ulysses) sequence parallelism vs the dense core, gradient
+check, end-to-end training, and the search's schedule auto-selection."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+
+
+@pytest.fixture
+def seq_mesh():
+    from flexflow_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(mesh_shape=(2, 4), axis_names=("data", "seq"))
+
+
+def _ref_core(q, k, v, causal):
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(seq_mesh, causal):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flexflow_tpu.kernels.ulysses_attention import ulysses_attention
+
+    rng = np.random.default_rng(0)
+    # heads (4) divisible by |seq| (4)
+    q = rng.normal(size=(2, 4, 32, 16)).astype(np.float32)
+    k = rng.normal(size=(2, 4, 32, 16)).astype(np.float32)
+    v = rng.normal(size=(2, 4, 32, 16)).astype(np.float32)
+    spec = NamedSharding(seq_mesh, P("data", None, "seq", None))
+    qd, kd, vd = (jax.device_put(jnp.asarray(a), spec) for a in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, seq_mesh, seq_axis="seq",
+                                 causal=causal)
+
+    out = f(qd, kd, vd)
+    ref = _ref_core(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # output sharding preserved (seq-sharded like the input)
+    assert out.sharding.spec == spec.spec
+
+
+def test_ulysses_grads_match(seq_mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.ulysses_attention import ulysses_attention
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(2, 4, 16, 8)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(2, 4, 16, 8)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(2, 4, 16, 8)).astype(np.float32))
+
+    def f_aa(q):
+        return jnp.sum(ulysses_attention(q, k, v, seq_mesh, seq_axis="seq",
+                                         causal=True) ** 2)
+
+    def f_ref(q):
+        return jnp.sum(_ref_core(q, k, v, True) ** 2)
+
+    g1 = jax.jit(jax.grad(f_aa))(q)
+    g2 = jax.grad(f_ref)(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_ulysses_rejects_indivisible_heads(seq_mesh):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.ulysses_attention import ulysses_attention
+
+    q = jnp.zeros((2, 3, 32, 8))  # 3 heads, |seq| = 4
+    with pytest.raises(AssertionError):
+        ulysses_attention(q, q, q, seq_mesh, seq_axis="seq")
+
+
+def test_seq_parallel_bert_trains_alltoall():
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.parallel.strategies import long_context_strategy
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    cfg = BertConfig.tiny(batch_size=4)
+    build_bert(ff, cfg)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy_fn=lambda pcg: long_context_strategy(
+                   pcg, dp=2, sp=4, mode="alltoall"))
+    assert dict(ff.mesh.shape) == {"data": 2, "seq": 4}
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, cfg.seq_len, cfg.hidden)).astype(np.float32)
+    y = rng.integers(0, 2, size=8).astype(np.int32)
+    ff.fit(x, y, epochs=1)  # ulysses attention inside the jitted step
+
+
+def test_search_selects_alltoall_schedule_for_ring_kind():
+    """When the search assigns the ring (sequence) kind and the head count
+    divides, the emitted strategy carries the all-to-all schedule exactly
+    when the shared cost rule (simulator.sequence_schedule) says it is
+    cheaper AND its score block fits HBM — costs and execution agree."""
+    from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.machine_view import MachineView  # noqa: F401
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, sequence_schedule
+    from flexflow_tpu.search.unity import assignment_to_strategy
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    cfg = BertConfig.tiny(batch_size=4)  # 4 heads
+    build_bert(ff, cfg)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.detect(8)
+    assignment, states = {}, {}
+    attn = []
+    for n in pcg.compute_nodes():
+        if n.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+            assignment[n.guid] = OpSharding(dp=2, tp=4, kind="ring")
+            states[n.guid] = "Q"
+            attn.append(n)
+        else:
+            assignment[n.guid] = OpSharding(dp=2, tp=4, kind="none")
+    strat = assignment_to_strategy(pcg, assignment, states, 2, 4,
+                                   machine=machine)
+    node = attn[0]
+    in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+    sched, _ = sequence_schedule(node, in_shapes, assignment[node.guid],
+                                 machine)
+    ns = strat.for_node(node.guid)
+    assert ns.extra.get("sequence_parallel_mode", "ring") == sched
+    # without a machine model the emission conservatively keeps ring
+    strat_nm = assignment_to_strategy(pcg, assignment, states, 2, 4)
+    assert "sequence_parallel_mode" not in strat_nm.for_node(node.guid).extra
+
+
+def test_sequence_schedule_memory_guard():
+    """Long-context shapes must keep the ring schedule: the alltoall score
+    block would blow past the HBM guard."""
+    from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, sequence_schedule
+
+    config = FFConfig()
+    config.batch_size = 1
+    ff = FFModel(config)
+    cfg = BertConfig(batch_size=1, seq_len=65536, hidden=64, num_heads=8,
+                     num_layers=1, intermediate=128)
+    build_bert(ff, cfg)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.detect(8)
+    node = [n for n in pcg.compute_nodes()
+            if n.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION][0]
+    in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+    sched, _ = sequence_schedule(node, in_shapes,
+                                 OpSharding(dp=1, tp=8, kind="ring"), machine)
+    # (1/1) * (8/8) * 65536^2 * 4B = 16 GiB score block > HBM/8 -> ring
+    assert sched == "ring"
+
+
+def test_long_context_strategy_rejects_bad_mode():
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.parallel.strategies import long_context_strategy
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    build_bert(ff, BertConfig.tiny(batch_size=4))
+    pcg = ff.create_pcg()
+    with pytest.raises(AssertionError):
+        long_context_strategy(pcg, dp=2, sp=4, mode="ulysses")
