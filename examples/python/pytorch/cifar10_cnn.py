@@ -1,0 +1,57 @@
+"""Load the .ff file exported by cifar10_cnn_torch.py and train on CIFAR-10
+(reference: examples/python/pytorch/cifar10_cnn.py — file_to_ff + cifar10
+loader + create_data_loader)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,  # noqa: E402
+                          MetricsType, SGDOptimizer)
+from flexflow_tpu.frontends.keras_datasets import cifar10  # noqa: E402
+from flexflow_tpu.frontends.torch_fx import file_to_ff  # noqa: E402
+
+
+def main(argv=None, ff_file=None, num_samples=256):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    b = config.batch_size
+    ff = FFModel(config)
+    input_tensor = ff.create_tensor((b, 3, 32, 32), DataType.DT_FLOAT)
+    out_tensors = file_to_ff(
+        ff_file or os.path.join(os.path.dirname(__file__), "cnn.ff"),
+        ff, [input_tensor])
+    ff.softmax(out_tensors[-1])
+
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY,
+                        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+
+    (x_train, y_train), _ = cifar10.load_data(num_samples)
+    x_train = x_train.astype("float32") / 255
+    y_train = y_train.astype("int32")
+    dl_x = ff.create_data_loader(input_tensor, x_train)
+    dl_y = ff.create_data_loader(ff.label_tensor, y_train)
+    ff.init_layers()
+
+    n = (num_samples // b) * b
+    ts_start = config.get_current_time()
+    perf = ff.fit(x_train[:n], y_train[:n], epochs=config.epochs)
+    run_time = 1e-6 * (config.get_current_time() - ts_start)
+    print(f"epochs {config.epochs}, ELAPSED TIME = {run_time:.4f}s, "
+          f"THROUGHPUT = {n * config.epochs / run_time:.2f} samples/s")
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    assert dl_x.num_samples == dl_y.num_samples == num_samples
+    return ff, perf
+
+
+if __name__ == "__main__":
+    ff_file = os.path.join(os.path.dirname(__file__), "cnn.ff")
+    if not os.path.exists(ff_file):
+        from cifar10_cnn_torch import main as export
+
+        export(ff_file)
+    main(sys.argv[1:], ff_file=ff_file)

@@ -1,0 +1,50 @@
+"""Define the CIFAR-10 CNN as a torch nn.Module and export it to the .ff
+file format (reference: examples/python/pytorch/cifar10_cnn_torch.py).
+The companion cifar10_cnn.py loads the file with file_to_ff and trains."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import torch  # noqa: E402
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu.frontends.torch_fx import PyTorchModel  # noqa: E402
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, 1, padding=1)
+        self.conv2 = nn.Conv2d(32, 32, 3, 1, padding=1)
+        self.pool1 = nn.MaxPool2d(2, 2)
+        self.conv3 = nn.Conv2d(32, 64, 3, 1, padding=1)
+        self.conv4 = nn.Conv2d(64, 64, 3, 1, padding=1)
+        self.pool2 = nn.MaxPool2d(2, 2)
+        self.flat1 = nn.Flatten()
+        self.linear1 = nn.Linear(4096, 512)
+        self.linear2 = nn.Linear(512, 10)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.relu(self.conv1(x))
+        y = self.relu(self.conv2(y))
+        y = self.pool1(y)
+        y = self.relu(self.conv3(y))
+        y = self.relu(self.conv4(y))
+        y = self.pool2(y)
+        y = self.flat1(y)
+        y = self.relu(self.linear1(y))
+        return self.linear2(y)
+
+
+def main(out_path="cnn.ff"):
+    ff_torch_model = PyTorchModel(CNN())
+    ff_torch_model.torch_to_file(out_path)
+    print(f"exported {out_path}")
+    return out_path
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "cnn.ff")

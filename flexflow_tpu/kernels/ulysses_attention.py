@@ -63,10 +63,13 @@ def ulysses_attention(q, k, v, mesh, seq_axis: str = "seq",
                                   tiled=True)
 
         out = _full_attn(fwd(q_blk), fwd(k_blk), fwd(v_blk), causal)
+        # cast BEFORE the output all-to-all: accumulation is complete, and
+        # moving bf16 instead of the f32 accumulator halves that
+        # collective's bytes (sequence_schedule prices it at input width)
+        out = out.astype(q_blk.dtype)
         # (b, h/P, s, d) -> (b, h, s/P, d)
-        out = lax.all_to_all(out, seq_axis, split_axis=2, concat_axis=1,
-                             tiled=True)
-        return out.astype(q_blk.dtype)
+        return lax.all_to_all(out, seq_axis, split_axis=2, concat_axis=1,
+                              tiled=True)
 
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)

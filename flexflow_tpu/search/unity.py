@@ -816,7 +816,10 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
     current = {n.guid: OpSharding(dp=dp, tp=tp if k != "none" else 1, kind=k)
                for n in nodes for k, _, _ in [random_choice(n)]}
     cur_t, _ = sim.simulate(pcg, current)
-    best, best_t = dict(current), cur_t
+    # best carries ITS OWN factorization: the restart below re-rolls
+    # (dp, tp), and the final strategy must be built around the mesh the
+    # best assignment was actually found under
+    best, best_t, best_fact = dict(current), cur_t, (dp, tp)
     for it in range(iterations):
         # occasionally rewrite the mesh factorization (reference: restart)
         if it % 100 == 99 and len(facts) > 1:
@@ -825,6 +828,8 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
                 dp=dp, tp=tp if k != "none" else 1, kind=k)
                 for n in nodes for k, _, _ in [random_choice(n)]}
             cur_t, _ = sim.simulate(pcg, current)
+            if cur_t < best_t:
+                best, best_t, best_fact = dict(current), cur_t, (dp, tp)
         node = rng.choice(nodes)
         kind, _, _ = random_choice(node)
         cand = dict(current)
@@ -834,6 +839,7 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
         if t < cur_t or rng.random() < math.exp(-(t - cur_t) / temperature):
             current, cur_t = cand, t
             if t < best_t:
-                best, best_t = dict(cand), t
+                best, best_t, best_fact = dict(cand), t, (dp, tp)
     states = {n.guid: "R" for n in nodes}
-    return assignment_to_strategy(pcg, best, states, dp, tp, machine=machine)
+    return assignment_to_strategy(pcg, best, states, *best_fact,
+                                  machine=machine)

@@ -197,3 +197,14 @@ def test_keras_func_cifar10_cnn_concat():
     _, perf = _load("keras", "func_cifar10_cnn_concat").main(
         ["-b", "4", "-e", "1"], num_samples=16)
     assert perf.train_all == 16
+
+
+def test_torch_cifar10_cnn_ff_file_pair(tmp_path):
+    """torch module -> .ff export -> file_to_ff -> train (reference:
+    examples/python/pytorch/cifar10_cnn_torch.py + cifar10_cnn.py)."""
+    pytest.importorskip("torch")
+    ff_file = str(tmp_path / "cnn.ff")
+    _load("pytorch", "cifar10_cnn_torch").main(ff_file)
+    _, perf = _load("pytorch", "cifar10_cnn").main(
+        ["-b", "8", "-e", "1"], ff_file=ff_file, num_samples=32)
+    assert perf.train_all == 32

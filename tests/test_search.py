@@ -124,3 +124,24 @@ def test_machine_model_file(tmp_path):
     assert m.generation == "v5p"
     assert m.matmul_efficiency == 0.5
     assert m.torus == (2, 4)
+
+
+def test_mcmc_restart_keeps_best_factorization():
+    """The every-100-iteration restart re-rolls (dp, tp); the returned
+    strategy must be built around the factorization its best assignment was
+    found under (mesh axis sizes consistent with the op shardings)."""
+    import numpy as np
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+
+    config = FFConfig()
+    config.batch_size = 16
+    ff = FFModel(config)
+    x_t = ff.create_tensor((16, 64))
+    t = ff.dense(x_t, 128, ActiMode.AC_MODE_RELU)
+    ff.dense(t, 8)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.detect(8)
+    s = mcmc_optimize(pcg, config, 8, machine=machine, iterations=250,
+                      seed=3)
+    assert int(np.prod(s.mesh_shape)) == 8
