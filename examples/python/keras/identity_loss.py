@@ -1,0 +1,35 @@
+"""Identity loss over a backend reduce_sum (reference:
+examples/python/keras/identity_loss.py — the model output IS the loss)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends import keras_backend as backend  # noqa: E402
+from flexflow_tpu.frontends.keras import Dense, Input, Model  # noqa: E402
+
+
+def main(argv=None):
+    input0 = Input(shape=(32,))
+    x0 = Dense(20, activation="relu")(input0)
+    out = backend.sum(x0, axis=1)  # (B,)
+
+    model = Model(input0, out)
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer={"class_name": "Adam",
+                             "config": {"learning_rate": 0.01}},
+                  loss="identity", metrics=("mean_absolute_error",))
+    n = model.ffconfig.batch_size * 4
+    rng = np.random.default_rng(0)
+    perf = model.fit(x=rng.standard_normal((n, 32)).astype(np.float32),
+                     y=np.zeros((n,), np.float32), epochs=2)
+    print("identity-loss example trained")
+    return model, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

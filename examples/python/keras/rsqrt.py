@@ -1,0 +1,40 @@
+"""Backend functional ops + node arithmetic (reference:
+examples/python/keras/rsqrt.py — out = rsqrt(x + inp2))."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import Dense, Input, Model  # noqa: E402
+from flexflow_tpu.frontends.keras_backend import rsqrt  # noqa: E402
+
+
+def main(argv=None):
+    inp1 = Input(shape=(32,))
+    inp2 = Input(shape=(20,))
+    x = Dense(20, activation="relu")(inp1)
+    out = rsqrt(x + inp2)
+
+    model = Model([inp1, inp2], out)
+    if argv:
+        model.ffconfig.parse_args(argv)
+    model.compile(optimizer={"class_name": "Adam",
+                             "config": {"learning_rate": 0.001}},
+                  loss="mean_squared_error",
+                  metrics=("mean_squared_error",))
+    n = model.ffconfig.batch_size * 4
+    rng = np.random.default_rng(0)
+    perf = model.fit(
+        x=[rng.standard_normal((n, 32)).astype(np.float32),
+           np.ones((n, 20), np.float32)],
+        y=rng.standard_normal((n, 20)).astype(np.float32),
+        epochs=2)
+    print(f"rsqrt example MSE = {perf.mean('mse_loss'):.4f}")
+    return model, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

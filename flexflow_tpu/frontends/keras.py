@@ -24,6 +24,7 @@ _LOSS_MAP = {
         LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
     "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "identity": LossType.LOSS_IDENTITY,
 }
 _METRIC_MAP = {
     "accuracy": MetricsType.METRICS_ACCURACY,
@@ -411,6 +412,7 @@ class Model(_BaseModel):
 
 # -- reference-parity submodules (python/flexflow/keras/{callbacks,datasets,
 # preprocessing}) exposed under the frontend namespace -------------------------
+from . import keras_backend as backend  # noqa: E402
 from . import keras_callbacks as callbacks  # noqa: E402
 from . import keras_datasets as datasets  # noqa: E402
 from . import keras_initializers as initializers  # noqa: E402

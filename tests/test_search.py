@@ -126,13 +126,14 @@ def test_machine_model_file(tmp_path):
     assert m.torus == (2, 4)
 
 
-def test_mcmc_restart_keeps_best_factorization():
+def test_mcmc_restart_keeps_best_factorization(monkeypatch):
     """The every-100-iteration restart re-rolls (dp, tp); the returned
-    strategy must be built around the factorization its best assignment was
-    found under (mesh axis sizes consistent with the op shardings)."""
-    import numpy as np
-
-    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+    strategy must be built around the factorization its BEST assignment was
+    found under. The fake cost model makes the very first (pre-restart)
+    assignment the global best, and the spy asserts the emission received
+    that factorization even though later restarts switched meshes."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.search import unity
 
     config = FFConfig()
     config.batch_size = 16
@@ -142,6 +143,34 @@ def test_mcmc_restart_keeps_best_factorization():
     ff.dense(t, 8)
     pcg = ff.create_pcg()
     machine = TPUMachineModel.detect(8)
-    s = mcmc_optimize(pcg, config, 8, machine=machine, iterations=250,
-                      seed=3)
-    assert int(np.prod(s.mesh_shape)) == 8
+    first_fact = unity.factorizations(8)[0]  # (8, 1)
+
+    captured = {}
+    real_ats = unity.assignment_to_strategy
+
+    def spy_ats(pcg, best, states, dp, tp, **kw):
+        captured["fact"] = (dp, tp)
+        return real_ats(pcg, best, states, dp, tp, **kw)
+
+    calls = []
+
+    def fake_simulate(self, pcg, assignment, states=None):
+        calls.append(max(sh.dp for sh in assignment.values()))
+        # first evaluation (the initial assignment under facts[0]) is the
+        # global best; everything after costs more
+        return (1.0 if len(calls) == 1 else 2.0), 0
+
+    monkeypatch.setattr(unity, "assignment_to_strategy", spy_ats)
+    monkeypatch.setattr(unity.Simulator, "simulate", fake_simulate)
+
+    for seed in range(10):
+        captured.clear()
+        calls.clear()
+        mcmc_optimize(pcg, config, 8, machine=machine, iterations=250,
+                      seed=seed)
+        assert captured["fact"] == first_fact, \
+            (seed, captured["fact"], first_fact)
+        if calls[-1] != first_fact[0]:
+            break  # a restart actually switched meshes before the end
+    else:
+        pytest.fail("no seed produced a mesh switch; test cannot bite")
