@@ -26,7 +26,8 @@ def main(argv=None):
     n = model.ffconfig.batch_size * 4
     rng = np.random.default_rng(0)
     perf = model.fit(x=rng.standard_normal((n, 32)).astype(np.float32),
-                     y=np.zeros((n,), np.float32), epochs=2)
+                     y=np.zeros((n,), np.float32),
+                     epochs=model.ffconfig.epochs)
     print("identity-loss example trained")
     return model, perf
 

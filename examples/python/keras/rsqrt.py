@@ -31,7 +31,7 @@ def main(argv=None):
         x=[rng.standard_normal((n, 32)).astype(np.float32),
            np.ones((n, 20), np.float32)],
         y=rng.standard_normal((n, 20)).astype(np.float32),
-        epochs=2)
+        epochs=model.ffconfig.epochs)
     print(f"rsqrt example MSE = {perf.mean('mse_loss'):.4f}")
     return model, perf
 

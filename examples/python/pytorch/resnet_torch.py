@@ -1,0 +1,84 @@
+"""ResNet defined in pure torch, traced through the fx frontend, trained on
+synthetic CIFAR-10-shaped data (reference:
+examples/python/pytorch/resnet_torch.py + resnet.py — there via torchvision;
+the BasicBlock stack is defined inline here since torchvision is not a
+dependency)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu import (FFConfig, FFModel, LossType,  # noqa: E402
+                          MetricsType, SGDOptimizer)
+from flexflow_tpu.frontends.torch_fx import PyTorchModel  # noqa: E402
+
+
+class BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = (nn.Conv2d(cin, cout, 1, stride, bias=False)
+                     if stride != 1 or cin != cout else nn.Identity())
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + self.down(x))
+
+
+class ResNetCifar(nn.Module):
+    """resnet18-shaped stack at CIFAR scale (2 blocks per stage)."""
+
+    def __init__(self, num_classes=10, width=16):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, width, 3, 1, 1, bias=False),
+            nn.BatchNorm2d(width), nn.ReLU())
+        self.layer1 = nn.Sequential(BasicBlock(width, width),
+                                    BasicBlock(width, width))
+        self.layer2 = nn.Sequential(BasicBlock(width, 2 * width, 2),
+                                    BasicBlock(2 * width, 2 * width))
+        self.layer3 = nn.Sequential(BasicBlock(2 * width, 4 * width, 2),
+                                    BasicBlock(4 * width, 4 * width))
+        self.pool = nn.AdaptiveAvgPool2d((1, 1))
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(4 * width, num_classes)
+
+    def forward(self, x):
+        y = self.layer3(self.layer2(self.layer1(self.stem(x))))
+        return self.fc(self.flat(self.pool(y)))
+
+
+def main(argv=None, num_samples=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    b = config.batch_size
+    ff = FFModel(config)
+    x_t = ff.create_tensor((b, 3, 32, 32))
+    net = ResNetCifar().eval()
+    outs = PyTorchModel(net).torch_to_ff(ff, [x_t])
+    ff.softmax(outs[0] if isinstance(outs, list) else outs)
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    n = num_samples or b * 4
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 3, 32, 32)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    perf = ff.fit(x, y, epochs=config.epochs)
+    print(f"train accuracy = {perf.accuracy():.4f}")
+    return ff, perf
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

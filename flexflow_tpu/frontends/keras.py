@@ -247,6 +247,11 @@ class Multiply(Layer):
         return ff.multiply(inputs[0], inputs[1], name=self.name)
 
 
+class Divide(Layer):
+    def apply(self, ff, inputs):
+        return ff.divide(inputs[0], inputs[1], name=self.name)
+
+
 class Maximum(Layer):
     """reference: examples/python/keras/elementwise_max_min.py."""
 

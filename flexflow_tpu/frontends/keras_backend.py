@@ -140,12 +140,26 @@ def _node_sub(self, other):
     return _arith(self, other, "Subtract", "scalar_sub")
 
 
+def _node_rsub(self, other):
+    # scalar - node == (-1) * node + scalar
+    if isinstance(other, (int, float)):
+        return _Scalar("scalar_add", other)(
+            _Scalar("scalar_multiply", -1.0)(self))
+    return NotImplemented
+
+
 def _node_mul(self, other):
     return _arith(self, other, "Multiply", "scalar_multiply")
+
+
+def _node_div(self, other):
+    return _arith(self, other, "Divide", "scalar_true_divide")
 
 
 _Node.__add__ = _node_add
 _Node.__radd__ = _node_add
 _Node.__sub__ = _node_sub
+_Node.__rsub__ = _node_rsub
 _Node.__mul__ = _node_mul
 _Node.__rmul__ = _node_mul
+_Node.__truediv__ = _node_div

@@ -438,6 +438,18 @@ def _convert_module(ffmodel: FFModel, mod, args, name: str):
             (mod.padding, mod.padding)
         return ffmodel.pool2d(x, k[0], k[1], st[0], st[1], p[0], p[1],
                               PoolType.POOL_AVG, name=name)
+    if isinstance(mod, nn.AdaptiveAvgPool2d):
+        # static shapes under XLA: lower to a plain AvgPool whose kernel is
+        # derived from the incoming spatial dims (torchvision resnet's
+        # AdaptiveAvgPool2d((1, 1)) head)
+        oh, ow = mod.output_size if isinstance(mod.output_size, tuple) else \
+            (mod.output_size, mod.output_size)
+        _b, _c, ih, iw = x.dims
+        assert ih % oh == 0 and iw % ow == 0, \
+            f"AdaptiveAvgPool2d: {ih}x{iw} not divisible by {oh}x{ow}"
+        kh, kw = ih // oh, iw // ow
+        return ffmodel.pool2d(x, kh, kw, kh, kw, 0, 0,
+                              PoolType.POOL_AVG, name=name)
     if isinstance(mod, nn.Flatten):
         return ffmodel.flat(x, name=name)
     if isinstance(mod, nn.Identity):

@@ -208,3 +208,10 @@ def test_torch_cifar10_cnn_ff_file_pair(tmp_path):
     _, perf = _load("pytorch", "cifar10_cnn").main(
         ["-b", "8", "-e", "1"], ff_file=ff_file, num_samples=32)
     assert perf.train_all == 32
+
+
+def test_torch_resnet_traced():
+    pytest.importorskip("torch")
+    _, perf = _load("pytorch", "resnet_torch").main(["-b", "4", "-e", "1"],
+                                                    num_samples=8)
+    assert perf.train_all == 8

@@ -509,7 +509,8 @@ def test_keras_node_scalar_arithmetic():
 
     inp = Input(shape=(8,))
     x = Dense(4)(inp)
-    out = 0.5 * x + 1.0 - 2.0  # scalar forms route to the scalar ops
+    out = (0.5 * x + 1.0 - 2.0) / 1.0  # scalar forms route to scalar ops
+    out = 0.0 - (0.0 - out)            # __rsub__ round-trip is identity
     model = Model(inp, out)
     model.ffconfig.batch_size = 4
     model.compile(optimizer="sgd", loss="mean_squared_error",
@@ -523,3 +524,36 @@ def test_keras_node_scalar_arithmetic():
          if "bias" in ws][0]
     np.testing.assert_allclose(pred, 0.5 * (xs @ k + b) + 1.0 - 2.0,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_torch_adaptive_avg_pool_alignment():
+    """AdaptiveAvgPool2d lowers to a derived-kernel AvgPool; numerics match
+    torch through the fx frontend with copied weights."""
+    torch = pytest.importorskip("torch")
+    from flexflow_tpu.frontends.torch_fx import (PyTorchModel,
+                                                 copy_torch_weights)
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(3, 6, 3, padding=1)
+            self.pool = torch.nn.AdaptiveAvgPool2d((1, 1))
+            self.flat = torch.nn.Flatten()
+            self.fc = torch.nn.Linear(6, 4)
+
+        def forward(self, x):
+            return self.fc(self.flat(self.pool(torch.relu(self.conv(x)))))
+
+    net = Net().eval()
+    config = FFConfig()
+    config.batch_size = 2
+    ff = FFModel(config)
+    x_t = ff.create_tensor((2, 3, 8, 8))
+    PyTorchModel(net).torch_to_ff(ff, [x_t])
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    copy_torch_weights(ff)
+    x = np.random.default_rng(0).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(ff.predict(x, batch_size=2), ref,
+                               rtol=1e-4, atol=1e-5)
