@@ -62,6 +62,13 @@ class PyTorchModel:
                 env[node.name] = _convert_function(
                     ffmodel, node, _args(env, node.args),
                     {k: _lookup(env, v) for k, v in node.kwargs.items()})
+                if node.op == "call_function" and \
+                        getattr(node.target, "__name__", "") == "setitem":
+                    # host setitem may have had to copy a read-only view;
+                    # later uses reference the SOURCE node, so rebind it
+                    src = node.args[0]
+                    if isinstance(src, fx.Node):
+                        env[src.name] = env[node.name]
             elif node.op == "get_attr":
                 attr = _fetch_attr(self.module, node.target)
                 if isinstance(attr, torch.Tensor):
@@ -333,6 +340,28 @@ def copy_torch_weights(ffmodel: FFModel) -> None:
             ffmodel.params[lname][wname] = jax.device_put(arr, cur.sharding)
 
 
+def _host_cmp_table():
+    import operator
+
+    import torch
+
+    # NOTE: no operator.eq here — the dedicated eq branch keeps python
+    # scalar/tuple == semantics (shape checks must yield a bool, not an
+    # elementwise array)
+    return {operator.lt: np.less, operator.gt: np.greater,
+            operator.le: np.less_equal, operator.ge: np.greater_equal,
+            operator.ne: np.not_equal,
+            torch.lt: np.less, torch.gt: np.greater,
+            torch.le: np.less_equal, torch.ge: np.greater_equal,
+            torch.ne: np.not_equal, torch.eq: np.equal}
+
+
+try:
+    _HOST_CMP = _host_cmp_table()
+except ImportError:  # torch not installed: frontend import stays lazy
+    _HOST_CMP = {}
+
+
 def _is_ff(v) -> bool:
     return isinstance(v, Tensor)
 
@@ -497,7 +526,38 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
                 i, j = args[1], args[2]
                 perm[i], perm[j] = perm[j], perm[i]
                 return np.transpose(x, perm)
-            if t in ("contiguous", "clone", "detach", "float"):
+            if t == "float":
+                return x.astype(np.float32)
+            if t == "long":
+                return x.astype(np.int64)
+            if t == "type_as":
+                other = args[1]
+                if _is_ff(other):
+                    from ..ffconst import dtype_to_jnp
+
+                    # dtype_to_jnp returns a usable dtype object (incl.
+                    # ml_dtypes bfloat16, which np.dtype(str) can't resolve)
+                    return x.astype(dtype_to_jnp(other.dtype))
+                return x.astype(np.asarray(other).dtype)
+            if t == "abs":
+                return np.abs(x)
+            if t == "bool":
+                return x.astype(bool)
+            if t == "int":
+                return x.astype(np.int32)
+            if t == "repeat":
+                reps = args[1:] if len(args) > 2 or not isinstance(
+                    args[1], (tuple, list)) else args[1]
+                return np.tile(x, [int(r) for r in reps])
+            if t == "unsqueeze":
+                return np.expand_dims(x, int(args[1]))
+            if t == "squeeze":
+                if len(args) > 1:
+                    dim = int(args[1])
+                    # torch semantics: squeeze of a non-1 dim is a no-op
+                    return np.squeeze(x, dim) if x.shape[dim] == 1 else x
+                return np.squeeze(x)
+            if t in ("contiguous", "clone", "detach"):
                 return x
             raise NotImplementedError(f"torch method {t} on host value")
         # ---- graph ops on Tensors -----------------------------------------
@@ -508,7 +568,10 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
             return ffmodel.reshape(x, [int(s) if isinstance(
                 s, (int, np.integer)) else -1 for s in shape])
         if t == "permute":
-            return ffmodel.transpose(x, list(args[1:]))
+            perm = list(args[1:])
+            if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+                perm = [int(p) for p in perm[0]]
+            return ffmodel.transpose(x, perm)
         if t == "transpose":
             perm = list(range(len(x.dims)))
             i, j = args[1], args[2]
@@ -553,6 +616,13 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
             from ..ffconst import DataType
 
             return ffmodel.cast(x, DataType.DT_FLOAT)
+        if t == "type_as":
+            other = args[1]
+            if _is_ff(other):
+                return ffmodel.cast(x, other.dtype)
+            from ..ffconst import jnp_to_dtype
+
+            return ffmodel.cast(x, jnp_to_dtype(np.asarray(other).dtype))
         if t == "contiguous" or t == "clone" or t == "detach":
             return x
         raise NotImplementedError(f"torch method {t}")
@@ -573,6 +643,23 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
             items = args[1] if isinstance(args[1], tuple) else (args[1],)
             return ffmodel.slice_tensor(obj, items)
         return obj[args[1]]
+    if t is operator.setitem:
+        obj, key, val = args[0], args[1], args[2]
+        if not _is_ff(obj) and not _is_ff(val):
+            obj = np.asarray(obj)
+            if obj.flags.writeable:
+                obj[key] = val  # in place: views created earlier stay live
+                return obj
+            # read-only (broadcast) source: copy + rebind in the trace loop.
+            # Views taken BEFORE this write won't observe it — warn.
+            import warnings
+
+            warnings.warn("fx setitem on a read-only host view: copying; "
+                          "earlier-created aliases will not see this write")
+            obj = np.array(obj)
+            obj[key] = val
+            return obj
+        raise NotImplementedError("setitem involving graph tensors")
     if t is torch.ones:
         shape = args[0] if isinstance(args[0], (tuple, list)) else args
         return np.ones([int(s) for s in shape], dtype=np.float32)
@@ -588,6 +675,59 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
     if t is operator.eq:
         if not _is_ff(args[0]) and not _is_ff(args[1]):
             return args[0] == args[1]
+    # ---- eager host arithmetic for static index computations (T5-style
+    # relative-position buckets: arange/abs/comparisons/log/min/where all
+    # run on host numpy at trace time; only the bias embedding lookup
+    # enters the graph, via the nn.Embedding constant-promotion path) ------
+    if t is torch.arange:
+        vals = list(args if len(args) > 1 or not isinstance(
+            args[0], (tuple, list)) else args[0])
+        if all(float(a) == int(a) for a in vals):
+            vals = [int(a) for a in vals]
+            default_dt = np.int64
+        else:  # float arange (frequency tables etc.) keeps real values
+            default_dt = np.float32
+        return np.arange(*vals, dtype=_np_dtype(kwargs.get("dtype"))
+                         if kwargs.get("dtype") is not None else default_dt)
+    if t is torch.abs and not _is_ff(args[0]):
+        return np.abs(np.asarray(args[0]))
+    if t in _HOST_CMP and not _is_ff(args[0]) and not _is_ff(args[1]):
+        return _HOST_CMP[t](np.asarray(args[0]), np.asarray(args[1]))
+    if t is torch.log:
+        if _is_ff(args[0]):
+            return ffmodel.log(args[0])
+        return np.log(np.asarray(args[0]))
+    # elementwise two-array form only: torch.min(x, dim:int) is a reduction
+    # returning (values, indices) — not supported here
+    if t is torch.min and len(args) == 2 and not _is_ff(args[0]) \
+            and not _is_ff(args[1]) and np.ndim(args[1]) > 0:
+        return np.minimum(np.asarray(args[0]), np.asarray(args[1]))
+    if t is torch.max and len(args) == 2 and not _is_ff(args[0]) \
+            and not _is_ff(args[1]) and np.ndim(args[1]) > 0:
+        return np.maximum(np.asarray(args[0]), np.asarray(args[1]))
+    if t is torch.full_like and not _is_ff(args[0]):
+        dt = kwargs.get("dtype")
+        return np.full_like(np.asarray(args[0]), args[1],
+                            dtype=_np_dtype(dt) if dt is not None else None)
+    if t is torch.full:
+        shape = [int(s) for s in args[0]]
+        fill = args[1] if len(args) > 1 else kwargs["fill_value"]
+        dt = kwargs.get("dtype")
+        return np.full(shape, fill,
+                       dtype=_np_dtype(dt) if dt is not None else None)
+    if t is torch.zeros_like and not _is_ff(args[0]):
+        return np.zeros_like(np.asarray(args[0]))
+    if t is torch.ones_like and not _is_ff(args[0]):
+        return np.ones_like(np.asarray(args[0]))
+    if t is torch.where and not any(_is_ff(a) for a in args[:3]):
+        return np.where(np.asarray(args[0]), np.asarray(args[1]),
+                        np.asarray(args[2]))
+    if t is torch.triu and not _is_ff(args[0]):
+        return np.triu(np.asarray(args[0]), k=kwargs.get(
+            "diagonal", args[1] if len(args) > 1 else 0))
+    if t is torch.tril and not _is_ff(args[0]):
+        return np.tril(np.asarray(args[0]), k=kwargs.get(
+            "diagonal", args[1] if len(args) > 1 else 0))
     if t is torch.nn.functional.scaled_dot_product_attention or \
             (getattr(t, "__name__", "") == "scaled_dot_product_attention"):
         # torch signature: (query, key, value, attn_mask=None, dropout_p=0.0,
@@ -658,6 +798,9 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
     if t is torch.cos:
         return ffmodel.cos(args[0])
     if t is operator.neg:
+        if not _is_ff(args[0]):
+            return -np.asarray(args[0]) if isinstance(
+                args[0], np.ndarray) else -args[0]
         return ffmodel.scalar_multiply(args[0], -1.0)
     if t is torch.sum:
         dims = kwargs.get("dim", args[1] if len(args) > 1 else None)

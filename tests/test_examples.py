@@ -215,3 +215,9 @@ def test_torch_resnet_traced():
     _, perf = _load("pytorch", "resnet_torch").main(["-b", "4", "-e", "1"],
                                                     num_samples=8)
     assert perf.train_all == 8
+
+
+def test_t5_mt5_example():
+    pytest.importorskip("transformers")
+    _load("pytorch/mt5", "mt5_ff").main(["-b", "2", "-e", "1"],
+                                        num_samples=4)
