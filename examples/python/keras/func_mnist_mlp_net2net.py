@@ -1,0 +1,68 @@
+"""Net2Net teacher->student weight transfer (reference:
+examples/python/keras/func_mnist_mlp_net2net.py — train a teacher MLP, copy
+its weights into a same-shape student via layer get_weights/set_weights,
+and verify the student starts at the teacher's accuracy)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu.frontends.keras import (Activation, Dense, Input,  # noqa: E402
+                                          Model, datasets)
+
+
+def main(argv=None, num_samples=512, teacher_epochs=None):
+    num_classes = 10
+    (x_train, y_train), _ = datasets.mnist.load_data()
+    x_train = (x_train.reshape(-1, 784).astype("float32") / 255)[:num_samples]
+    y_train = np.reshape(y_train.astype("int32"),
+                         (len(y_train), 1))[:num_samples]
+
+    # teacher
+    inp1 = Input(shape=(784,))
+    d1 = Dense(128, activation="relu")
+    d2 = Dense(128, activation="relu")
+    d3 = Dense(num_classes)
+    out = Activation("softmax")(d3(d2(d1(inp1))))
+    teacher = Model(inp1, out)
+    if argv:
+        teacher.ffconfig.parse_args(argv)
+    teacher.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=("accuracy",))
+    b = teacher.ffconfig.batch_size
+    n = (len(x_train) // b) * b
+    teacher.fit(x_train[:n], y_train[:n],
+                epochs=teacher_epochs or teacher.ffconfig.epochs)
+    t_eval = teacher.evaluate(x_train[:n], y_train[:n])
+
+    d1_kernel, d1_bias = d1.get_weights(teacher)
+    d2_kernel, d2_bias = d2.get_weights(teacher)
+    d3_kernel, d3_bias = d3.get_weights(teacher)
+
+    # student: same shape, weights transferred instead of re-initialized
+    inp2 = Input(shape=(784,))
+    sd1 = Dense(128, activation="relu")
+    sd2 = Dense(128, activation="relu")
+    sd3 = Dense(num_classes)
+    sout = Activation("softmax")(sd3(sd2(sd1(inp2))))
+    student = Model(inp2, sout)
+    student.ffconfig.batch_size = b
+    student.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=("accuracy",))
+    sd1.set_weights(student, d1_kernel, d1_bias)
+    sd2.set_weights(student, d2_kernel, d2_bias)
+    sd3.set_weights(student, d3_kernel, d3_bias)
+
+    s_eval = student.evaluate(x_train[:n], y_train[:n])
+    print(f"teacher acc = {t_eval.get_accuracy():.2f}%, "
+          f"student (transferred, untrained) acc = "
+          f"{s_eval.get_accuracy():.2f}%")
+    assert abs(t_eval.get_accuracy() - s_eval.get_accuracy()) < 1e-3
+    return teacher, student
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -65,7 +65,7 @@ def main(argv=None, num_samples=None):
     # synthetic copy task (reference trains on text pairs)
     import jax.random as jrandom
 
-    steps = (num_samples or b * 4) // b
+    steps = max((num_samples or b * 4) // b, 1)
     rng = np.random.default_rng(0)
     step_fn = ff.executor.make_train_step()
     params, opt_state = ff.params, ff.opt_state

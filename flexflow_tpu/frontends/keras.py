@@ -64,6 +64,43 @@ class Layer:
     def apply(self, ff: FFModel, inputs):
         raise NotImplementedError
 
+    # -- weight access for net2net-style transfer (reference:
+    #    keras/layers get_weights/set_weights used by the *_net2net
+    #    examples). ``_ff_tensor`` is recorded by the model build. --------
+    def _ff_params(self, ffmodel):
+        ff = ffmodel.ffmodel if hasattr(ffmodel, "ffmodel") else ffmodel
+        assert getattr(self, "_ff_tensor", None) is not None, \
+            f"{self.name}: layer not built yet (compile the model first)"
+        return ff, self._ff_tensor.owner_layer.name
+
+    def get_weights(self, ffmodel):
+        """Returns (kernel, bias) — or a 1-tuple for bias-less layers."""
+        import numpy as np
+
+        ff, lname = self._ff_params(ffmodel)
+        ws = ff.params[lname]
+        out = [np.asarray(ws[k]) for k in ("kernel", "bias") if k in ws]
+        return tuple(out) if out else tuple(
+            np.asarray(v) for v in ws.values())
+
+    def set_weights(self, ffmodel, kernel, bias=None):
+        """Positional write mirroring get_weights' order: kernel/bias where
+        declared, else the layer's params in declaration order (so e.g.
+        BatchNormalization scale/bias round-trip too)."""
+        import jax
+        import numpy as np
+
+        ff, lname = self._ff_params(ffmodel)
+        ws = ff.params[lname]
+        keys = [k for k in ("kernel", "bias") if k in ws] or list(ws)
+        vals = [kernel] + ([] if bias is None else [bias])
+        for k, arr in zip(keys, vals):
+            cur = ws[k]
+            arr = np.asarray(arr, dtype=np.asarray(cur).dtype)
+            assert arr.shape == cur.shape, (lname, k, arr.shape, cur.shape)
+            ws[k] = jax.device_put(
+                arr, cur.sharding if hasattr(cur, "sharding") else None)
+
 
 class _Node:
     def __init__(self, layer: Layer, inputs: List["_Node"]):
@@ -373,6 +410,7 @@ class Sequential(_BaseModel):
         t = ff.create_tensor((self.ffconfig.batch_size,) + inp.shape, dtype)
         for layer in self.layers[1:]:
             t = layer.apply(ff, [t])
+            layer._ff_tensor = t[0] if isinstance(t, list) else t
 
 
 class Model(_BaseModel):
@@ -404,6 +442,7 @@ class Model(_BaseModel):
             else:
                 ins = [build_node(i) for i in node.inputs]
                 t = node.layer.apply(ff, ins)
+                node.layer._ff_tensor = t[0] if isinstance(t, list) else t
             built[key] = t
             return t
 

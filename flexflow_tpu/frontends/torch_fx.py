@@ -645,7 +645,9 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return obj[args[1]]
     if t is operator.setitem:
         obj, key, val = args[0], args[1], args[2]
-        if not _is_ff(obj) and not _is_ff(val):
+        keys = key if isinstance(key, tuple) else (key,)
+        if not _is_ff(obj) and not _is_ff(val) and \
+                not any(_is_ff(k) for k in keys):
             obj = np.asarray(obj)
             if obj.flags.writeable:
                 obj[key] = val  # in place: views created earlier stay live
@@ -692,7 +694,16 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
     if t is torch.abs and not _is_ff(args[0]):
         return np.abs(np.asarray(args[0]))
     if t in _HOST_CMP and not _is_ff(args[0]) and not _is_ff(args[1]):
-        return _HOST_CMP[t](np.asarray(args[0]), np.asarray(args[1]))
+        a, b = args[0], args[1]
+        if isinstance(a, (tuple, list)) or isinstance(b, (tuple, list)):
+            # shape comparisons must yield a python bool, not elementwise
+            import operator as _op
+
+            py = {np.less: _op.lt, np.greater: _op.gt,
+                  np.less_equal: _op.le, np.greater_equal: _op.ge,
+                  np.not_equal: _op.ne, np.equal: _op.eq}
+            return py[_HOST_CMP[t]](a, b)
+        return _HOST_CMP[t](np.asarray(a), np.asarray(b))
     if t is torch.log:
         if _is_ff(args[0]):
             return ffmodel.log(args[0])
@@ -713,8 +724,11 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         shape = [int(s) for s in args[0]]
         fill = args[1] if len(args) > 1 else kwargs["fill_value"]
         dt = kwargs.get("dtype")
-        return np.full(shape, fill,
-                       dtype=_np_dtype(dt) if dt is not None else None)
+        if dt is not None:
+            np_dt = _np_dtype(dt)
+        else:  # torch defaults float fills to f32 (not numpy's f64)
+            np_dt = np.float32 if isinstance(fill, float) else None
+        return np.full(shape, fill, dtype=np_dt)
     if t is torch.zeros_like and not _is_ff(args[0]):
         return np.zeros_like(np.asarray(args[0]))
     if t is torch.ones_like and not _is_ff(args[0]):
@@ -799,8 +813,7 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return ffmodel.cos(args[0])
     if t is operator.neg:
         if not _is_ff(args[0]):
-            return -np.asarray(args[0]) if isinstance(
-                args[0], np.ndarray) else -args[0]
+            return -args[0]
         return ffmodel.scalar_multiply(args[0], -1.0)
     if t is torch.sum:
         dims = kwargs.get("dim", args[1] if len(args) > 1 else None)

@@ -221,3 +221,8 @@ def test_t5_mt5_example():
     pytest.importorskip("transformers")
     _load("pytorch/mt5", "mt5_ff").main(["-b", "2", "-e", "1"],
                                         num_samples=4)
+
+
+def test_keras_net2net_weight_transfer():
+    _, _ = _load("keras", "func_mnist_mlp_net2net").main(
+        ["-b", "16", "-e", "1"], num_samples=64)
