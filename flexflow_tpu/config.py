@@ -70,6 +70,9 @@ class FFConfig:
     # TPU-native extension: sequence/context parallelism (ring attention) in
     # the search space; no reference analog (SURVEY §5 long-context)
     enable_sequence_parallel: bool = True
+    # TPU-native extension: GPipe (pp, dp) grids as search candidates;
+    # the reference reserves OP_PIPELINE but ships no schedule
+    enable_pipeline_parallel: bool = True
     enable_inplace_optimizations: bool = True
     search_num_nodes: int = -1
     search_num_workers: int = -1
@@ -166,6 +169,8 @@ class FFConfig:
                 self.enable_attribute_parallel = True
             elif a == "--disable-sequence-parallel":
                 self.enable_sequence_parallel = False
+            elif a == "--disable-pipeline-parallel":
+                self.enable_pipeline_parallel = False
             elif a == "--fusion":
                 self.perform_fusion = True
             elif a == "--memory-search":

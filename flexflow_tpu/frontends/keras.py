@@ -69,9 +69,14 @@ class Layer:
     #    examples). ``_ff_tensor`` is recorded by the model build. --------
     def _ff_params(self, ffmodel):
         ff = ffmodel.ffmodel if hasattr(ffmodel, "ffmodel") else ffmodel
-        assert getattr(self, "_ff_tensor", None) is not None, \
+        tensors = getattr(self, "_ff_tensors", [])
+        assert tensors, \
             f"{self.name}: layer not built yet (compile the model first)"
-        return ff, self._ff_tensor.owner_layer.name
+        assert len(tensors) == 1, (
+            f"{self.name}: applied at {len(tensors)} graph positions — "
+            "each call instantiates separate weights here (no keras-style "
+            "sharing), so per-layer get/set_weights would be ambiguous")
+        return ff, tensors[0].owner_layer.name
 
     def get_weights(self, ffmodel):
         """Returns (kernel, bias) — or a 1-tuple for bias-less layers."""
@@ -94,6 +99,9 @@ class Layer:
         ws = ff.params[lname]
         keys = [k for k in ("kernel", "bias") if k in ws] or list(ws)
         vals = [kernel] + ([] if bias is None else [bias])
+        assert len(vals) == len(keys), (
+            f"{lname}: set_weights got {len(vals)} arrays for params "
+            f"{keys} — pass every declared weight")
         for k, arr in zip(keys, vals):
             cur = ws[k]
             arr = np.asarray(arr, dtype=np.asarray(cur).dtype)
@@ -409,8 +417,11 @@ class Sequential(_BaseModel):
         dtype = DataType.DT_INT32 if "int" in inp.dtype else DataType.DT_FLOAT
         t = ff.create_tensor((self.ffconfig.batch_size,) + inp.shape, dtype)
         for layer in self.layers[1:]:
+            layer._ff_tensors = []  # recompile starts a fresh record
+        for layer in self.layers[1:]:
             t = layer.apply(ff, [t])
-            layer._ff_tensor = t[0] if isinstance(t, list) else t
+            layer._ff_tensors = layer._ff_tensors + \
+                [t[0] if isinstance(t, list) else t]
 
 
 class Model(_BaseModel):
@@ -424,6 +435,18 @@ class Model(_BaseModel):
 
     def _build(self, ff: FFModel) -> None:
         built: Dict[int, Any] = {}
+
+        def reset_records(node: _Node):
+            if id(node) in seen_reset:
+                return
+            seen_reset.add(id(node))
+            node.layer._ff_tensors = []
+            for i in node.inputs:
+                reset_records(i)
+
+        seen_reset: set = set()
+        for out in self.outputs:
+            reset_records(out)
 
         def build_node(node: _Node):
             # Input tensors key by the LAYER: the same Input may be wrapped
@@ -442,7 +465,9 @@ class Model(_BaseModel):
             else:
                 ins = [build_node(i) for i in node.inputs]
                 t = node.layer.apply(ff, ins)
-                node.layer._ff_tensor = t[0] if isinstance(t, list) else t
+                node.layer._ff_tensors = getattr(
+                    node.layer, "_ff_tensors", []) + \
+                    [t[0] if isinstance(t, list) else t]
             built[key] = t
             return t
 

@@ -53,6 +53,7 @@ class FFModel:
         # manual-loop staging (API parity: forward/backward/update phases)
         self._staged: Dict[str, Any] = {}
         self._recompile_state = None
+        self._pipeline_trainer = None  # set by compile for GPipe strategies
         # {cache_op_name: latest score_fn value} filled during fit
         # (reference: cache.cc score futures read by the recompile trigger)
         self.cache_scores: Dict[str, float] = {}
@@ -598,6 +599,20 @@ class FFModel:
         self.params = self.executor.init_params(self.config.numpy_seed())
         self.opt_state = self.optimizer.init_state(self.params)
 
+        # searched GPipe pipeline: training routes through PipelineTrainer
+        # on a (pp, dp) grid seeded with the SAME initialized params; fit
+        # copies the trained weights back so eval/predict/checkpoint see
+        # them (reference: OP_PIPELINE is enum-only — this is beyond parity)
+        self._pipeline_trainer = None
+        if getattr(self.strategy, "pipeline", None):
+            from .parallel.pipeline import PipelineTrainer
+
+            pp, pdp, n_micro = self.strategy.pipeline
+            self._pipeline_trainer = PipelineTrainer(
+                self, pp=pp, dp=pdp, n_micro=n_micro,
+                optimizer=self.optimizer, loss_type=loss_type)
+            self._pipeline_trainer.load_params(self.params)
+
     def create_pcg(self):
         """Layer graph -> PCG (reference: create_operators_from_layers,
         src/runtime/model.cc:2785). Usable standalone for search experiments
@@ -717,6 +732,8 @@ class FFModel:
         y = self._prep_label(y)
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
+        if self._pipeline_trainer is not None:
+            return self._fit_pipeline(xs, y, batch_size, epochs, shuffle)
         step_fn = self.executor.make_train_step()
         from .data.dataloader import batch_iterator, prefetch_iterator
 
@@ -799,6 +816,69 @@ class FFModel:
         self._last_fit_samples = steps_per_epoch * batch_size * epochs
         if self.config.profiling and elapsed > 0:
             print(f"THROUGHPUT = {self._last_fit_samples / elapsed:.2f} "
+                  f"samples/s")
+        return self._perf
+
+    def _fit_pipeline(self, xs, y, batch_size, epochs, shuffle) -> PerfMetrics:
+        """GPipe training loop for a searched pipeline strategy: batches go
+        through PipelineTrainer.train_step; the trained stage params are
+        copied back into the Executor's pytree afterwards so
+        eval/predict/checkpoint operate on the trained weights."""
+        import jax
+
+        from .data.dataloader import batch_iterator
+
+        tr = self._pipeline_trainer
+        # re-seed from the CURRENT executor params: weights may have been
+        # set after compile (copy_torch_weights, Layer.set_weights); note
+        # this also resets the trainer's optimizer state each fit
+        tr.load_params(self.params)
+        # the microbatch count was chosen for config.batch_size at search
+        # time; re-derive it for the batch size actually passed
+        if batch_size % tr.dp != 0:
+            raise ValueError(
+                f"pipeline strategy needs batch_size % dp == 0 "
+                f"(batch {batch_size}, dp {tr.dp})")
+        tr.n_micro = next(m for m in (2 * tr.pp, tr.pp, 2, 1)
+                          if batch_size % m == 0 and
+                          (batch_size // m) % tr.dp == 0)
+        loss_key = {
+            LossType.LOSS_CATEGORICAL_CROSSENTROPY: "cce_loss",
+            LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+                "sparse_cce_loss",
+            LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE: "mse_loss",
+            LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: "mse_loss",
+        }.get(self.loss_type, "sparse_cce_loss")
+        self._perf = PerfMetrics()
+        t0 = time.time()
+        step = 0
+        loss = None
+        for epoch in range(epochs):
+            it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
+                                seed=self.config.numpy_seed() + epoch)
+            for batch in it:
+                bx, by = batch[:-1], batch[-1]
+                loss = tr.train_step(list(bx), by, rng_seed=step)
+                step += 1
+                # loss-only metrics: train_step returns the scalar loss
+                # (accuracy-style metrics need the eval path)
+                self._perf.update({
+                    "train_all": by.shape[0],
+                    loss_key: float(loss) * by.shape[0]})
+                if self.config.profiling and \
+                        step % max(self.config.print_freq, 1) == 0:
+                    print(f"step {step}: loss={float(loss):.4f}")
+        for lname, ws in tr.export_params().items():
+            for wname, arr in ws.items():
+                cur = self.params[lname][wname]
+                self.params[lname][wname] = jax.device_put(
+                    np.asarray(arr, dtype=np.asarray(cur).dtype),
+                    cur.sharding if hasattr(cur, "sharding") else None)
+        self._last_fit_time = time.time() - t0
+        self._last_fit_samples = step * batch_size
+        if self.config.profiling and self._last_fit_time > 0:
+            print(f"THROUGHPUT = "
+                  f"{self._last_fit_samples / self._last_fit_time:.2f} "
                   f"samples/s")
         return self._perf
 

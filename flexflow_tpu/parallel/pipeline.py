@@ -370,6 +370,16 @@ class PipelineTrainer:
         self.params = new
         self.opt_states = [self.optimizer.init_state(p) for p in self.params]
 
+    def export_params(self) -> Dict[str, Dict[str, Any]]:
+        """Inverse of load_params: gather the trained per-stage params back
+        into one {layer: {weight: host array}} pytree (fit copies them into
+        the Executor's params so eval/predict/checkpoint see the training)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for p in self.params:
+            for lname, ws in p.items():
+                out[lname] = {k: np.asarray(v) for k, v in ws.items()}
+        return out
+
     # ---------------------------------------------------------------- train
     def _microbatches(self, arrays: List[np.ndarray]) -> List[List[Any]]:
         n = arrays[0].shape[0]

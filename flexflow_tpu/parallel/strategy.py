@@ -44,6 +44,9 @@ class Strategy:
         default_factory=dict)
     # input batch sharding axis (the data-parallel dim)
     data_axis: str = "data"
+    # GPipe pipeline selected by the search: (pp, dp, n_micro). Training
+    # routes through parallel.pipeline.PipelineTrainer; None = pure SPMD.
+    pipeline: Optional[Tuple[int, int, int]] = None
 
     def for_node(self, guid: int) -> NodeStrategy:
         return self.node_strategies.setdefault(guid, NodeStrategy())
@@ -54,6 +57,7 @@ class Strategy:
             "mesh_shape": list(self.mesh_shape),
             "axis_names": list(self.axis_names),
             "data_axis": self.data_axis,
+            "pipeline": list(self.pipeline) if self.pipeline else None,
             "nodes": {},
         }
         for guid, ns in self.node_strategies.items():
@@ -76,7 +80,9 @@ class Strategy:
         d = json.loads(text)
         s = Strategy(mesh_shape=tuple(d["mesh_shape"]),
                      axis_names=tuple(d["axis_names"]),
-                     data_axis=d.get("data_axis", "data"))
+                     data_axis=d.get("data_axis", "data"),
+                     pipeline=tuple(d["pipeline"])
+                     if d.get("pipeline") else None)
         by_name = {n.name: n.guid for n in pcg.topo_order()}
         for name, nd in d["nodes"].items():
             if name not in by_name:

@@ -319,6 +319,55 @@ def simulate_best(sim: Simulator, pcg: PCG,
         return sim.simulate(pcg, assignment, states)[0]
 
 
+def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
+                      n_micro: int) -> Tuple[float, int]:
+    """(step time, per-chip memory) for a GPipe (pp, dp) grid with
+    ``n_micro`` microbatches.
+
+    Stage times come from the same per-op cost model as the SPMD search
+    (flops-balanced contiguous stages, parallel.pipeline.split_stages); the
+    schedule serializes on the slowest stage: T = Σ_s t_s + (m-1)·max_s t_s
+    (the GPipe bubble) + boundary activation hops + per-stage weight-grad
+    allreduce over dp. Microbatch stage time scales linearly from the
+    full-batch op costs. Memory = the heaviest stage's weights (replicated
+    over its dp group) + one microbatch of live activations (the trainer
+    rematerializes the stage forward in backward)."""
+    from ..parallel.pipeline import split_stages
+
+    stages = split_stages(pcg, pp)
+    stage_of = {g: s for s, guids in enumerate(stages) for g in guids}
+    sh = OpSharding(dp=dp)
+    stage_t = [0.0] * pp
+    stage_sync = [0.0] * pp
+    stage_w = [0] * pp
+    stage_act = [0] * pp
+    for node in pcg.compute_nodes():
+        in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+        c = sim.op_cost(node, in_shapes, sh)
+        s = stage_of[node.guid]
+        stage_t[s] += c.forward_time + c.backward_time
+        # each stage allreduces ITS weights over its own dp group; groups
+        # are disjoint chip sets, so stages sync concurrently
+        stage_sync[s] += c.sync_time
+        stage_w[s] += c.weights_memory
+        stage_act[s] += c.inputs_memory + c.outputs_memory
+    sync = max(stage_sync)
+    micro = [t / max(n_micro, 1) for t in stage_t]
+    bubble_time = sum(micro) + (n_micro - 1) * max(micro)
+    # boundary activations hop between stage submeshes once per microbatch
+    # per direction; serialized with the bubble only on the critical path
+    comm = 0.0
+    el_bw = sim.machine.ici_bandwidth
+    for s in range(pp - 1):
+        last = stages[s][-1]
+        node = pcg.nodes[last]
+        nbytes = sum(int(np.prod(shape)) for shape in node.out_shapes) * 4
+        comm += 2 * (nbytes / max(dp, 1)) / el_bw  # fwd + bwd hop, per batch
+    mem = max(2 * w + act // max(n_micro, 1)  # weights + grads + micro acts
+              for w, act in zip(stage_w, stage_act))
+    return bubble_time + comm + sync, mem
+
+
 # ------------------------------------------------------------------ strategies
 def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                            states: Dict[int, str], dp: int, tp: int,
@@ -762,6 +811,38 @@ def unity_search(pcg: PCG, config, n_dev: int,
                     feasible = cand
             if feasible is not None:
                 best = feasible
+
+        # GPipe pipeline candidate (beyond the reference, which only
+        # reserves OP_PIPELINE): the same op-cost model prices (pp, dp)
+        # GPipe grids — per-stage weight placement removes the full-model
+        # gradient allreduce, so pipeline wins for weight-heavy graphs
+        if best is not None and n_dev >= 2 and \
+                getattr(config, "enable_pipeline_parallel", True):
+            n_nodes = len(base_pcg.compute_nodes())
+            for pp in (2, 4, 8):
+                if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
+                    continue
+                pdp = n_dev // pp
+                micro = next((m for m in (2 * pp, pp, 2)
+                              if batch % m == 0 and
+                              (batch // m) % max(pdp, 1) == 0), None)
+                if micro is None:
+                    continue
+                t_pipe, m_pipe = simulate_pipeline(sim, base_pcg, pp, pdp,
+                                                   micro)
+                _log.info("pipeline pp=%d dp=%d m=%d -> %.3f ms, %.1f MiB",
+                          pp, pdp, micro, t_pipe * 1e3, m_pipe / 2 ** 20)
+                if t_pipe < best.sim_time and (
+                        not config.perform_memory_search or
+                        m_pipe <= hbm_budget):
+                    from ..parallel.strategy import data_parallel_strategy
+
+                    strat = data_parallel_strategy(pcg, n_dev)
+                    strat.pipeline = (pp, pdp, micro)
+                    best = SearchResult(
+                        strategy=strat, assignment={}, sim_time=t_pipe,
+                        sim_memory=m_pipe, mesh_shape=(n_dev, 1),
+                        pcg=None, states=None)
 
     if best is None:
         from ..parallel.strategy import data_parallel_strategy

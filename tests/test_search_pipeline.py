@@ -1,0 +1,110 @@
+"""Searched GPipe pipeline parallelism: cost model, discovery by
+unity_search, strategy JSON round-trip, and end-to-end compile/fit/eval
+routing through PipelineTrainer (beyond the reference, which only reserves
+OP_PIPELINE)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                          SGDOptimizer)
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import OpSharding, Simulator
+from flexflow_tpu.search.unity import (simulate_best, simulate_pipeline,
+                                       unity_search)
+
+
+def _mlp(width, depth=8, batch=8, out=13):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, width))
+    t = x
+    for _ in range(depth):
+        t = ff.dense(t, width, ActiMode.AC_MODE_RELU)
+    ff.dense(t, out)
+    return ff, config
+
+
+def test_simulate_pipeline_more_microbatches_shrink_bubble():
+    ff, _ = _mlp(512)
+    pcg = ff.create_pcg()
+    sim = Simulator(TPUMachineModel.detect(8))
+    t2, m2 = simulate_pipeline(sim, pcg, pp=4, dp=2, n_micro=2)
+    t8, m8 = simulate_pipeline(sim, pcg, pp=4, dp=2, n_micro=8)
+    assert 0 < t8 < t2  # (m-1)/m bubble amortizes with more microbatches
+    assert 0 < m8 <= m2  # smaller microbatches hold fewer live activations
+
+
+def test_search_discovers_pipeline_when_tp_inapplicable():
+    """Dense width 1001 (= 7*11*13) admits no tensor-parallel degree, so
+    DP pays the full-model gradient allreduce — the GPipe candidate's
+    per-stage weight placement wins in simulation and the search returns a
+    pipeline strategy."""
+    ff, config = _mlp(1001)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.detect(8)
+    res = unity_search(pcg.copy(), config, 8, machine=machine,
+                       return_result=True, insert_ir_nodes=False)
+    assert res.strategy.pipeline is not None
+    pp, dp, m = res.strategy.pipeline
+    assert pp * dp == 8
+    dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    t_dp = simulate_best(Simulator(machine), pcg, dp8, {})
+    assert res.sim_time < t_dp
+
+    # JSON round-trip keeps the schedule (export/import-strategy flags)
+    from flexflow_tpu.parallel.strategy import Strategy
+
+    s2 = Strategy.from_json(res.strategy.to_json(pcg), pcg)
+    assert s2.pipeline == (pp, dp, m)
+
+    # --disable-pipeline-parallel removes the candidate
+    config.enable_pipeline_parallel = False
+    res2 = unity_search(pcg.copy(), config, 8, machine=machine,
+                        return_result=True, insert_ir_nodes=False)
+    assert res2.strategy.pipeline is None
+
+
+def test_pipeline_strategy_trains_end_to_end():
+    """compile() with a pipeline strategy builds the GPipe trainer seeded
+    with the executor's params; fit() trains through it and copies the
+    trained weights back so eval/predict see them."""
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    batch, width, classes = 16, 65, 4  # 65 = 5*13: tp-resistant too
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x_t = ff.create_tensor((batch, width))
+    t = ff.dense(x_t, width, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, width, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, classes)
+    ff.softmax(t)
+
+    def strategy_fn(pcg):
+        s = data_parallel_strategy(pcg, 8)
+        s.pipeline = (2, 4, 4)
+        return s
+
+    from flexflow_tpu import MetricsType
+
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY,
+                        MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+               strategy_fn=strategy_fn)
+    assert ff._pipeline_trainer is not None
+    assert ff._pipeline_trainer.pp == 2 and ff._pipeline_trainer.dp == 4
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(width, classes))
+    x = rng.normal(size=(64, width)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    before = ff.eval(x, y)
+    perf = ff.fit(x, y, epochs=8)
+    assert perf.train_all == 64 * 8
+    after = ff.eval(x, y)
+    # trained weights flowed back into the executor params
+    assert after.mean("sparse_cce_loss") < before.mean("sparse_cce_loss")
+    assert ff.predict(x[:batch]).shape == (batch, classes)
