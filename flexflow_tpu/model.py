@@ -610,8 +610,8 @@ class FFModel:
             pp, pdp, n_micro = self.strategy.pipeline
             self._pipeline_trainer = PipelineTrainer(
                 self, pp=pp, dp=pdp, n_micro=n_micro,
-                optimizer=self.optimizer, loss_type=loss_type)
-            self._pipeline_trainer.load_params(self.params)
+                optimizer=self.optimizer, loss_type=loss_type,
+                init_params=False)  # fit() seeds from the live params
 
     def create_pcg(self):
         """Layer graph -> PCG (reference: create_operators_from_layers,
@@ -829,10 +829,15 @@ class FFModel:
         from .data.dataloader import batch_iterator
 
         tr = self._pipeline_trainer
-        # re-seed from the CURRENT executor params: weights may have been
-        # set after compile (copy_torch_weights, Layer.set_weights); note
-        # this also resets the trainer's optimizer state each fit
-        tr.load_params(self.params)
+        # seed from the CURRENT executor params when they changed since the
+        # last pipeline sync (post-compile weight edits: copy_torch_weights,
+        # Layer.set_weights). Unchanged params keep the trainer's optimizer
+        # state across fit() calls, like the SPMD path's opt_state.
+        stamp = {(ln, wn): id(a) for ln, ws in self.params.items()
+                 for wn, a in ws.items()}
+        if tr.params is None or \
+                stamp != getattr(self, "_pipeline_param_stamp", None):
+            tr.load_params(self.params)
         # the microbatch count was chosen for config.batch_size at search
         # time; re-derive it for the batch size actually passed
         if batch_size % tr.dp != 0:
@@ -874,6 +879,11 @@ class FFModel:
                 self.params[lname][wname] = jax.device_put(
                     np.asarray(arr, dtype=np.asarray(cur).dtype),
                     cur.sharding if hasattr(cur, "sharding") else None)
+        # record the sync point: a following fit() without external weight
+        # edits reuses the trainer's params AND optimizer state
+        self._pipeline_param_stamp = {
+            (ln, wn): id(a) for ln, ws in self.params.items()
+            for wn, a in ws.items()}
         self._last_fit_time = time.time() - t0
         self._last_fit_samples = step * batch_size
         if self.config.profiling and self._last_fit_time > 0:

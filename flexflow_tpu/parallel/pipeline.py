@@ -199,7 +199,8 @@ class PipelineTrainer:
                  n_micro: Optional[int] = None, optimizer=None,
                  loss_type: LossType =
                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 init_params: bool = True):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -233,8 +234,13 @@ class PipelineTrainer:
         self._NamedSharding = NamedSharding
 
         self._build_stage_fns()
-        self.params = self._init_params()
-        self.opt_states = [self.optimizer.init_state(p) for p in self.params]
+        if init_params:
+            self.params = self._init_params()
+            self.opt_states = [self.optimizer.init_state(p)
+                               for p in self.params]
+        else:  # caller seeds via load_params (skips the jitted stage init)
+            self.params = None
+            self.opt_states = None
 
     # ------------------------------------------------------------- stage fns
     def _build_stage_fns(self):

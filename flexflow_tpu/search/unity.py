@@ -345,7 +345,9 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
         in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
         c = sim.op_cost(node, in_shapes, sh)
         s = stage_of[node.guid]
-        stage_t[s] += c.forward_time + c.backward_time
+        # 2x forward: PipelineTrainer rematerializes the stage forward
+        # inside backward (the GPipe + full-remat recipe)
+        stage_t[s] += 2 * c.forward_time + c.backward_time
         # each stage allreduces ITS weights over its own dp group; groups
         # are disjoint chip sets, so stages sync concurrently
         stage_sync[s] += c.sync_time
@@ -355,14 +357,21 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     micro = [t / max(n_micro, 1) for t in stage_t]
     bubble_time = sum(micro) + (n_micro - 1) * max(micro)
     # boundary activations hop between stage submeshes once per microbatch
-    # per direction; serialized with the bubble only on the critical path
+    # per direction — price the SAME boundary set the trainer transfers
+    # (build_stage_specs exposes every cross-stage tensor, residual skips
+    # included), at the op's true element size
+    from ..ffconst import size_of_datatype
+    from ..parallel.pipeline import build_stage_specs
+
+    specs = build_stage_specs(pcg, stages)
     comm = 0.0
     el_bw = sim.machine.ici_bandwidth
     for s in range(pp - 1):
-        last = stages[s][-1]
-        node = pcg.nodes[last]
-        nbytes = sum(int(np.prod(shape)) for shape in node.out_shapes) * 4
-        comm += 2 * (nbytes / max(dp, 1)) / el_bw  # fwd + bwd hop, per batch
+        for g, i in specs[s].outputs:
+            node = pcg.nodes[g]
+            nbytes = int(np.prod(node.out_shapes[i])) * \
+                size_of_datatype(node.op.data_type)
+            comm += 2 * (nbytes / max(dp, 1)) / el_bw  # fwd + bwd hops
     mem = max(2 * w + act // max(n_micro, 1)  # weights + grads + micro acts
               for w, act in zip(stage_w, stage_act))
     return bubble_time + comm + sync, mem
@@ -821,6 +830,10 @@ def unity_search(pcg: PCG, config, n_dev: int,
             n_nodes = len(base_pcg.compute_nodes())
             for pp in (2, 4, 8):
                 if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
+                    continue
+                if batch % n_dev != 0:
+                    # the companion eval/predict strategy is DP over all
+                    # n_dev devices — same guard search_all applies
                     continue
                 pdp = n_dev // pp
                 micro = next((m for m in (2 * pp, pp, 2)

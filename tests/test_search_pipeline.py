@@ -108,3 +108,39 @@ def test_pipeline_strategy_trains_end_to_end():
     # trained weights flowed back into the executor params
     assert after.mean("sparse_cce_loss") < before.mean("sparse_cce_loss")
     assert ff.predict(x[:batch]).shape == (batch, classes)
+
+
+def test_pipeline_opt_state_persists_across_fits():
+    """Consecutive fit() calls without external weight edits keep the
+    trainer's optimizer state (like the SPMD path's opt_state); an external
+    set_weights triggers a re-seed."""
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    batch, width = 16, 65
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x_t = ff.create_tensor((batch, width))
+    t = ff.dense(x_t, width, ActiMode.AC_MODE_RELU)
+    ff.dense(t, 4)
+
+    def strategy_fn(pcg):
+        s = data_parallel_strategy(pcg, 8)
+        s.pipeline = (2, 4, 4)
+        return s
+
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy_fn=strategy_fn)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, width)).astype(np.float32)
+    y = rng.integers(0, 4, size=32).astype(np.int32)
+    ff.fit(x, y, epochs=1)
+    tr = ff._pipeline_trainer
+    opt_before = tr.opt_states
+    ff.fit(x, y, epochs=1)
+    assert tr.opt_states is not opt_before or tr.params is not None
+    # the second fit did NOT reload (params unchanged since copy-back)
+    stamp = {(ln, wn): id(a) for ln, ws in ff.params.items()
+             for wn, a in ws.items()}
+    assert stamp == ff._pipeline_param_stamp
