@@ -819,6 +819,20 @@ class FFModel:
                   f"samples/s")
         return self._perf
 
+    def _param_stamp(self):
+        """Identity snapshot of the param arrays. Holds REFERENCES (not raw
+        ids) so CPython id reuse after a free can never fake a match."""
+        return {(ln, wn): a for ln, ws in self.params.items()
+                for wn, a in ws.items()}
+
+    def _params_match_stamp(self) -> bool:
+        old = getattr(self, "_pipeline_param_stamp", None)
+        if old is None:
+            return False
+        new = self._param_stamp()
+        return old.keys() == new.keys() and \
+            all(new[k] is old[k] for k in new)
+
     def _fit_pipeline(self, xs, y, batch_size, epochs, shuffle) -> PerfMetrics:
         """GPipe training loop for a searched pipeline strategy: batches go
         through PipelineTrainer.train_step; the trained stage params are
@@ -833,10 +847,7 @@ class FFModel:
         # last pipeline sync (post-compile weight edits: copy_torch_weights,
         # Layer.set_weights). Unchanged params keep the trainer's optimizer
         # state across fit() calls, like the SPMD path's opt_state.
-        stamp = {(ln, wn): id(a) for ln, ws in self.params.items()
-                 for wn, a in ws.items()}
-        if tr.params is None or \
-                stamp != getattr(self, "_pipeline_param_stamp", None):
+        if tr.params is None or not self._params_match_stamp():
             tr.load_params(self.params)
         # the microbatch count was chosen for config.batch_size at search
         # time; re-derive it for the batch size actually passed
@@ -881,9 +892,7 @@ class FFModel:
                     cur.sharding if hasattr(cur, "sharding") else None)
         # record the sync point: a following fit() without external weight
         # edits reuses the trainer's params AND optimizer state
-        self._pipeline_param_stamp = {
-            (ln, wn): id(a) for ln, ws in self.params.items()
-            for wn, a in ws.items()}
+        self._pipeline_param_stamp = self._param_stamp()
         self._last_fit_time = time.time() - t0
         self._last_fit_samples = step * batch_size
         if self.config.profiling and self._last_fit_time > 0:

@@ -319,6 +319,36 @@ def simulate_best(sim: Simulator, pcg: PCG,
         return sim.simulate(pcg, assignment, states)[0]
 
 
+def pipeline_microbatch_safe(pcg: PCG, batch: int) -> bool:
+    """Whether GPipe microbatching preserves the graph's semantics: ops
+    that bake the global batch size into their attributes or capacity math
+    (reshape targets, MoE dispatch buffers, cache state) would compute
+    wrong shapes on a microbatch — those graphs keep SPMD strategies."""
+    unsafe_types = {OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
+                    OperatorType.OP_AGG_SPEC, OperatorType.OP_EXPERTS,
+                    OperatorType.OP_CACHE}
+    for n in pcg.compute_nodes():
+        ot = n.op.op_type
+        if ot in unsafe_types:
+            return False
+        if ot == OperatorType.OP_RESHAPE and batch > 1:
+            tgt = n.op.attrs.get("shape", ())
+            # an explicit LEADING dim divisible by the batch is
+            # batch-derived — (b, 5, 16), (b*seq, vocab); trailing dims
+            # that merely share a factor (heads, hidden) are fine
+            if tgt and isinstance(tgt[0], (int, np.integer)) and \
+                    tgt[0] > 0 and tgt[0] % batch == 0:
+                return False
+        if ot == OperatorType.OP_SLICE:
+            items = n.op.attrs.get("items", ())
+            if items and not (items[0][0] == "slice" and
+                              items[0][1] == "none" and
+                              items[0][2] == "none" and
+                              items[0][3] in ("none", 1)):
+                return False  # indexing/striding into the batch dim
+    return True
+
+
 def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
                       n_micro: int) -> Tuple[float, int]:
     """(step time, per-chip memory) for a GPipe (pp, dp) grid with
@@ -826,14 +856,14 @@ def unity_search(pcg: PCG, config, n_dev: int,
         # GPipe grids — per-stage weight placement removes the full-model
         # gradient allreduce, so pipeline wins for weight-heavy graphs
         if best is not None and n_dev >= 2 and \
-                getattr(config, "enable_pipeline_parallel", True):
+                getattr(config, "enable_pipeline_parallel", True) and \
+                batch % n_dev == 0 and \
+                pipeline_microbatch_safe(base_pcg, batch):
+            # batch % n_dev: the companion eval/predict strategy is DP
+            # over all n_dev devices — same guard search_all applies
             n_nodes = len(base_pcg.compute_nodes())
             for pp in (2, 4, 8):
                 if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
-                    continue
-                if batch % n_dev != 0:
-                    # the companion eval/predict strategy is DP over all
-                    # n_dev devices — same guard search_all applies
                     continue
                 pdp = n_dev // pp
                 micro = next((m for m in (2 * pp, pp, 2)

@@ -139,8 +139,34 @@ def test_pipeline_opt_state_persists_across_fits():
     tr = ff._pipeline_trainer
     opt_before = tr.opt_states
     ff.fit(x, y, epochs=1)
-    assert tr.opt_states is not opt_before or tr.params is not None
-    # the second fit did NOT reload (params unchanged since copy-back)
-    stamp = {(ln, wn): id(a) for ln, ws in ff.params.items()
-             for wn, a in ws.items()}
-    assert stamp == ff._pipeline_param_stamp
+    # the second fit did NOT reload: the optimizer-state list object the
+    # trainer updates in place survives (load_params would rebuild it)
+    assert tr.opt_states is opt_before
+    assert ff._params_match_stamp()
+
+    # an external weight edit invalidates the stamp -> next fit re-seeds
+    d0 = ff.get_layer_by_id(0)
+    k = d0.get_parameter_by_id(0)
+    k.set_weights(ff, np.asarray(ff.params[list(ff.params)[0]]["kernel"]))
+    assert not ff._params_match_stamp()
+    ff.fit(x, y, epochs=1)
+    assert tr.opt_states is not opt_before  # re-seeded from edited params
+
+
+def test_pipeline_skips_batch_baked_graphs():
+    """Graphs whose ops bake the batch size (DLRM's interact reshape, MoE
+    dispatch capacity) must keep SPMD strategies — microbatching would
+    recompute wrong shapes."""
+    from flexflow_tpu.search.unity import pipeline_microbatch_safe
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 80))
+    t = ff.reshape(x, (8, 5, 16))  # explicit batch dim in the target
+    ff.dense(ff.flat(t), 4)
+    pcg = ff.create_pcg()
+    assert not pipeline_microbatch_safe(pcg, 8)
+
+    ff2, _ = _mlp(1001)
+    assert pipeline_microbatch_safe(ff2.create_pcg(), 8)
