@@ -190,3 +190,34 @@ def test_hf_t5_seq2seq_traces_and_aligns(tiny_t5):
                      decoder_input_ids=torch.as_tensor(
                          np_dec.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_distilbert_traces_and_aligns():
+    """Third HF family (DistilBERT) through the same trace path — no
+    frontend changes needed, evidence the node coverage generalizes."""
+    from transformers import DistilBertConfig, DistilBertModel
+
+    cfg = DistilBertConfig(dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+                           vocab_size=100, max_position_embeddings=16,
+                           dropout=0.0, attention_dropout=0.0)
+    module = DistilBertModel(cfg).eval()
+    batch, seq = 2, 8
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    ids = ff.create_tensor((batch, seq), DataType.DT_INT32,
+                           name="input_ids")
+    outputs = PyTorchModel(module, is_hf_model=True).torch_to_ff(
+        ff, [ids], input_names=["input_ids"])
+    last = outputs["last_hidden_state"]
+    ff.compile(loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               final_tensor=last)
+    copy_torch_weights(ff)
+    rng = np.random.default_rng(0)
+    np_ids = rng.integers(0, cfg.vocab_size,
+                          size=(batch, seq)).astype(np.int32)
+    got = ff.predict(np_ids, batch_size=batch)
+    with torch.no_grad():
+        ref = module(torch.as_tensor(np_ids.astype(np.int64))
+                     ).last_hidden_state.numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
