@@ -62,6 +62,10 @@ class FFModel:
     def create_tensor(self, dims: Sequence[int],
                       dtype: DataType = DataType.DT_FLOAT,
                       create_grad: bool = True, name: str = "") -> Tensor:
+        if not isinstance(dtype, DataType):
+            raise TypeError(
+                f"create_tensor dtype must be a DataType, got {dtype!r} "
+                "(signature: create_tensor(dims, dtype, create_grad, name))")
         t = Tensor(dims, dtype, create_grad=create_grad,
                    name=name or f"input_{len(self._input_tensors)}", model=self)
         self._input_tensors.append(t)
@@ -472,7 +476,7 @@ class FFModel:
         graph sink (needed for multi-output frontends, e.g. HF ModelOutput
         dicts where last_hidden_state is not a sink)."""
         from .execution.executor import Executor
-        from .parallel.mesh import build_mesh
+        from .parallel.mesh import build_mesh, mesh_for_strategy
         from .parallel.pcg import PCG
         from .parallel.strategy import Strategy, data_parallel_strategy
         from .ops.base import op_class_for
@@ -514,15 +518,11 @@ class FFModel:
         if strategy is not None:
             # explicit strategy (hand-written or search output)
             self.strategy = strategy
-            self.mesh = build_mesh(self.config,
-                                   mesh_shape=strategy.mesh_shape,
-                                   axis_names=strategy.axis_names)
+            self.mesh = mesh_for_strategy(self.config, strategy)
         elif self.config.import_strategy_file:
             with open(self.config.import_strategy_file) as f:
                 self.strategy = Strategy.from_json(f.read(), pcg)
-            self.mesh = build_mesh(self.config,
-                                   mesh_shape=self.strategy.mesh_shape,
-                                   axis_names=self.strategy.axis_names)
+            self.mesh = mesh_for_strategy(self.config, self.strategy)
         elif self.config.only_data_parallel or (
                 n_dev == 1 and not (self.config.search_num_nodes > 0
                                     or self.config.search_num_workers > 0)):
@@ -543,9 +543,7 @@ class FFModel:
             # Unity search (SURVEY §7 stage 5); falls back to DP if the
             # search finds nothing better
             self.strategy = self._run_search(pcg, n_dev)
-            self.mesh = build_mesh(self.config,
-                                   mesh_shape=self.strategy.mesh_shape,
-                                   axis_names=self.strategy.axis_names)
+            self.mesh = mesh_for_strategy(self.config, self.strategy)
 
         if self.config.export_strategy_file and \
                 not getattr(self, "_exported_search_target", False):
@@ -669,8 +667,19 @@ class FFModel:
             # the machine we actually have. Without an export file the
             # search would burn its whole budget producing nothing — skip.
             if self.config.export_strategy_file:
+                # multi-node target: the machine model carries the DCN
+                # factor so the search prices inter-node collectives
+                # (reference: EnhancedMachineModel, simulator.h:212-606)
+                machine = None
+                if nodes > 1 and n_search % nodes == 0 and \
+                        not self.config.machine_model_file:
+                    from .search.machine_model import TPUMachineModel
+
+                    machine = TPUMachineModel.detect(n_search)
+                    machine.num_hosts = nodes
                 target_pcg = pcg.copy()
                 strat = unity_search(target_pcg, self.config, n_search,
+                                     machine=machine,
                                      protected_guids=(self.final_guid,))
                 with open(self.config.export_strategy_file, "w") as f:
                     f.write(strat.to_json(target_pcg))

@@ -49,6 +49,16 @@ def build_mesh(config=None, mesh_shape: Optional[Sequence[int]] = None,
     return Mesh(dev_array, axis_names)
 
 
+def mesh_for_strategy(config, strategy):
+    """Build the mesh a Strategy calls for: hybrid ICI x DCN layout when the
+    search placed an axis factor across hosts, plain mesh otherwise."""
+    if getattr(strategy, "hybrid", None):
+        return build_hybrid_mesh(strategy.hybrid[0], strategy.hybrid[1],
+                                 strategy.axis_names)
+    return build_mesh(config, mesh_shape=strategy.mesh_shape,
+                      axis_names=strategy.axis_names)
+
+
 def mesh_axis_size(mesh, axis: str) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
 

@@ -47,6 +47,11 @@ class Strategy:
     # GPipe pipeline selected by the search: (pp, dp, n_micro). Training
     # routes through parallel.pipeline.PipelineTrainer; None = pure SPMD.
     pipeline: Optional[Tuple[int, int, int]] = None
+    # multi-host placement: (ici_shape, dcn_shape) with
+    # ici[i] * dcn[i] == mesh_shape[i]; the mesh is then built with
+    # build_hybrid_mesh so an axis's DCN factor never splits an ICI ring
+    # (reference: inter- vs intra-node placement, simulator.h:212-606)
+    hybrid: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
 
     def for_node(self, guid: int) -> NodeStrategy:
         return self.node_strategies.setdefault(guid, NodeStrategy())
@@ -58,6 +63,8 @@ class Strategy:
             "axis_names": list(self.axis_names),
             "data_axis": self.data_axis,
             "pipeline": list(self.pipeline) if self.pipeline else None,
+            "hybrid": [list(self.hybrid[0]), list(self.hybrid[1])]
+            if self.hybrid else None,
             "nodes": {},
         }
         for guid, ns in self.node_strategies.items():
@@ -82,7 +89,9 @@ class Strategy:
                      axis_names=tuple(d["axis_names"]),
                      data_axis=d.get("data_axis", "data"),
                      pipeline=tuple(d["pipeline"])
-                     if d.get("pipeline") else None)
+                     if d.get("pipeline") else None,
+                     hybrid=(tuple(d["hybrid"][0]), tuple(d["hybrid"][1]))
+                     if d.get("hybrid") else None)
         by_name = {n.name: n.guid for n in pcg.topo_order()}
         for name, nd in d["nodes"].items():
             if name not in by_name:

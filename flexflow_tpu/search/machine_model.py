@@ -35,6 +35,11 @@ class TPUMachineModel:
     """Analog of MachineModel v0/v1 with TPU parameters."""
 
     num_chips: int = 1
+    # hosts/slices connected by DCN; chips within a host share an ICI torus.
+    # Mirrors the reference's inter-node vs intra-node split
+    # (EnhancedMachineModel, simulator.h:212-606; machine_config_example's
+    # NIC vs NVLink rows).
+    num_hosts: int = 1
     generation: str = "v5e"
     peak_flops: float = 197e12  # bf16
     peak_flops_f32: float = 98.5e12
@@ -53,14 +58,15 @@ class TPUMachineModel:
 
     @staticmethod
     def from_generation(gen: str, num_chips: int = 1,
-                        torus: Optional[Tuple[int, ...]] = None
-                        ) -> "TPUMachineModel":
+                        torus: Optional[Tuple[int, ...]] = None,
+                        num_hosts: int = 1) -> "TPUMachineModel":
         peak, hbm_bw, hbm_gib, ici_bw, links = TPU_GENERATIONS.get(
             gen, TPU_GENERATIONS["v5e"])
         if torus is None:
-            torus = _default_torus(num_chips)
+            torus = _default_torus(num_chips // max(num_hosts, 1))
         return TPUMachineModel(
-            num_chips=num_chips, generation=gen, peak_flops=peak,
+            num_chips=num_chips, num_hosts=num_hosts, generation=gen,
+            peak_flops=peak,
             peak_flops_f32=peak / 2, hbm_bandwidth=hbm_bw,
             hbm_capacity=hbm_gib * 1024 ** 3, ici_bandwidth=ici_bw,
             ici_links_per_chip=links, torus=torus)
@@ -84,6 +90,8 @@ class TPUMachineModel:
                 setattr(m, field, float(kv[field]))
         if "hbm_capacity" in kv:
             m.hbm_capacity = int(float(kv["hbm_capacity"]))
+        if "num_hosts" in kv:
+            m.num_hosts = int(kv["num_hosts"])
         if "torus" in kv:
             m.torus = tuple(int(x) for x in kv["torus"].split("x"))
         return m
@@ -98,48 +106,109 @@ class TPUMachineModel:
 
         devs = jax.devices()
         n = num_chips or len(devs)
+        # multi-host runs: each process owns one slice's worth of chips, so
+        # the DCN factor is the process count (hosts == slices here)
+        hosts = jax.process_count() if n == len(devs) else 1
+        hosts = hosts if n % max(hosts, 1) == 0 else 1
         kind = devs[0].device_kind.lower()
         for gen in TPU_GENERATIONS:
             if gen in kind.replace(" ", "").replace("lite", "e"):
-                return TPUMachineModel.from_generation(gen, n)
+                return TPUMachineModel.from_generation(gen, n,
+                                                       num_hosts=hosts)
         if "v5 lite" in kind or "v5lite" in kind:
-            return TPUMachineModel.from_generation("v5e", n)
+            return TPUMachineModel.from_generation("v5e", n, num_hosts=hosts)
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        return TPUMachineModel.from_generation(gen, n)
+        return TPUMachineModel.from_generation(gen, n, num_hosts=hosts)
+
+    @property
+    def chips_per_host(self) -> int:
+        return max(self.num_chips // max(self.num_hosts, 1), 1)
 
     # ---- communication cost primitives (α-β model over the torus) -----------
-    def allreduce_time(self, bytes_per_chip: int, num_participants: int
-                       ) -> float:
-        """Ring/torus all-reduce: 2*(n-1)/n * bytes over the per-chip ICI
-        bandwidth (bidirectional rings use multiple links)."""
+    # ``medium``: "ici" (within a slice) or "dcn" (across hosts). DCN is a
+    # per-HOST NIC shared by every chip of the slice — ``nic_sharers`` is the
+    # number of chips on one host participating in concurrent distinct
+    # collective groups, dividing the NIC bandwidth between them (reference:
+    # EnhancedMachineModel's shared NIC channel, simulator.h:311-364).
+    def _link(self, medium: str, nic_sharers: int, links: int
+              ) -> Tuple[float, float]:
+        if medium == "dcn":
+            return (self.dcn_bandwidth / max(nic_sharers, 1),
+                    self.dcn_latency)
+        return (self.ici_bandwidth * links, self.ici_latency)
+
+    def allreduce_time(self, bytes_per_chip: int, num_participants: int,
+                       medium: str = "ici", nic_sharers: int = 1) -> float:
+        """Ring all-reduce: 2*(n-1)/n * bytes over the per-chip link
+        bandwidth (bidirectional ICI rings use two links)."""
         if num_participants <= 1 or bytes_per_chip == 0:
             return 0.0
-        eff_bw = self.ici_bandwidth * min(self.ici_links_per_chip, 2)
+        eff_bw, lat = self._link(medium, nic_sharers,
+                                 min(self.ici_links_per_chip, 2))
         steps = 2 * (num_participants - 1)
-        return (self.ici_latency * steps
+        return (lat * steps
                 + steps / num_participants * bytes_per_chip / eff_bw)
 
-    def allgather_time(self, bytes_per_chip: int, num_participants: int
-                       ) -> float:
+    def allgather_time(self, bytes_per_chip: int, num_participants: int,
+                       medium: str = "ici", nic_sharers: int = 1) -> float:
         if num_participants <= 1 or bytes_per_chip == 0:
             return 0.0
-        eff_bw = self.ici_bandwidth * min(self.ici_links_per_chip, 2)
+        eff_bw, lat = self._link(medium, nic_sharers,
+                                 min(self.ici_links_per_chip, 2))
         steps = num_participants - 1
-        return (self.ici_latency * steps
+        return (lat * steps
                 + steps * bytes_per_chip / eff_bw)
 
-    def alltoall_time(self, bytes_per_chip: int, num_participants: int
-                      ) -> float:
+    def alltoall_time(self, bytes_per_chip: int, num_participants: int,
+                      medium: str = "ici", nic_sharers: int = 1) -> float:
         if num_participants <= 1 or bytes_per_chip == 0:
             return 0.0
         # each chip exchanges (n-1)/n of its data over its links
-        eff_bw = self.ici_bandwidth * self.ici_links_per_chip
-        return (self.ici_latency * (num_participants - 1)
+        eff_bw, lat = self._link(medium, nic_sharers,
+                                 self.ici_links_per_chip)
+        return (lat * (num_participants - 1)
                 + bytes_per_chip * (num_participants - 1)
                 / num_participants / eff_bw)
 
-    def p2p_time(self, num_bytes: int) -> float:
+    def p2p_time(self, num_bytes: int, medium: str = "ici") -> float:
+        if medium == "dcn":
+            return self.dcn_latency + num_bytes / self.dcn_bandwidth
         return self.ici_latency + num_bytes / self.ici_bandwidth
+
+    # ---- hierarchical (ICI within a slice, DCN across) ----------------------
+    # The standard multi-slice algorithm: reduce within the slice first so
+    # only 1/ici_n of the data crosses DCN, then the cross-slice phase, then
+    # the local broadcast (the reduce-scatter + allgather pair costs the same
+    # as one local allreduce in ring terms).
+    def hier_allreduce_time(self, bytes_per_chip: int, ici_n: int,
+                            dcn_n: int, nic_sharers: int = 1) -> float:
+        if dcn_n <= 1:
+            return self.allreduce_time(bytes_per_chip, ici_n)
+        t = self.allreduce_time(bytes_per_chip, ici_n)
+        t += self.allreduce_time(bytes_per_chip // max(ici_n, 1), dcn_n,
+                                 medium="dcn", nic_sharers=nic_sharers)
+        return t
+
+    def hier_allgather_time(self, bytes_per_chip: int, ici_n: int,
+                            dcn_n: int, nic_sharers: int = 1) -> float:
+        if dcn_n <= 1:
+            return self.allgather_time(bytes_per_chip, ici_n)
+        # gather across DCN first (small shards), then flood the slice
+        t = self.allgather_time(bytes_per_chip, dcn_n, medium="dcn",
+                                nic_sharers=nic_sharers)
+        t += self.allgather_time(bytes_per_chip * dcn_n, ici_n)
+        return t
+
+    def hier_alltoall_time(self, bytes_per_chip: int, ici_n: int,
+                           dcn_n: int, nic_sharers: int = 1) -> float:
+        if dcn_n <= 1:
+            return self.alltoall_time(bytes_per_chip, ici_n)
+        # (dcn_n-1)/dcn_n of each chip's data crosses DCN; the rest rides ICI
+        dcn_frac = (dcn_n - 1) / dcn_n
+        t = self.alltoall_time(int(bytes_per_chip * dcn_frac) + 1, dcn_n,
+                               medium="dcn", nic_sharers=nic_sharers)
+        t += self.alltoall_time(bytes_per_chip // max(dcn_n, 1), ici_n)
+        return t
 
 
 def _default_torus(n: int) -> Tuple[int, ...]:

@@ -76,8 +76,26 @@ class OpSharding:
         return self.dp * (self.tp if self.kind != "none" else self.act_tp)
 
 
+def op_in_state(sh: Optional["OpSharding"], out_state: str) -> str:
+    """The sharding state an op's chosen kind CONSUMES (col eats R and emits
+    S; row eats S and emits R; ring eats/emits Q; state-preserving kinds eat
+    what they emit). Used to price resharding on the true input edge, not
+    the producer-out vs consumer-out mismatch."""
+    if sh is None:
+        return "R"
+    if sh.kind == "col":
+        return "R"
+    if sh.kind == "row":
+        return "S"
+    if sh.kind == "ring":
+        return "Q"
+    if sh.kind in ("heads", "table", "expert"):
+        return "R"
+    return out_state
+
+
 def sequence_schedule(node: PCGNode, in_shapes, sh: "OpSharding",
-                      machine) -> Tuple[str, float]:
+                      machine, tp_dcn: int = 1) -> Tuple[str, float]:
     """Pick the sequence-parallel schedule for a ring-kind attention op and
     return (schedule, comm_time): "ring" (k/v rotation,
     kernels/ring_attention.py) or "alltoall" (Ulysses head re-partition,
@@ -91,9 +109,14 @@ def sequence_schedule(node: PCGNode, in_shapes, sh: "OpSharding",
     el = size_of_datatype(node.op.data_type)
     in_bytes = sum(int(np.prod(s)) for s in in_shapes) * el
     deg = max(sh.degree, 1)
+    tp_ici = max(sh.tp // max(tp_dcn, 1), 1)
+    # concurrent ring groups per host share the NIC (same formula as
+    # Simulator._nic_sharers, so sim and emission price identically)
+    sharers = max(machine.chips_per_host // tp_ici, 1)
     # k+v are 2 of the 3 equally-sized self-attention inputs
     kv_per_chip = int(2 * in_bytes / 3) // deg
-    ring_t = machine.allgather_time(kv_per_chip, sh.tp)
+    ring_t = machine.hier_allgather_time(kv_per_chip, tp_ici, tp_dcn,
+                                         nic_sharers=sharers)
     heads = node.op.attrs.get("num_heads", 0)
     if not heads or heads % sh.tp != 0:
         return "ring", ring_t
@@ -102,7 +125,9 @@ def sequence_schedule(node: PCGNode, in_shapes, sh: "OpSharding",
     if score_bytes > machine.hbm_capacity / 8:
         return "ring", ring_t
     # 4 all-to-alls (q, k, v in; out back) of the local activation volume
-    aa_t = 4 * machine.alltoall_time(int(in_bytes / 3) // deg, sh.tp)
+    aa_t = 4 * machine.hier_alltoall_time(int(in_bytes / 3) // deg,
+                                          tp_ici, tp_dcn,
+                                          nic_sharers=sharers)
     if aa_t < ring_t:
         return "alltoall", aa_t
     return "ring", ring_t
@@ -120,6 +145,27 @@ class Simulator:
         # analytically across shardings)
         self._key_calibration: Dict[Tuple, float] = {}
         self._dispatch_overhead: Optional[float] = None
+        # which mesh axis carries the machine's DCN factor for the candidate
+        # being costed (reference: intra- vs inter-node pricing in
+        # EnhancedMachineModel, simulator.h:212-606). dp_dcn * tp_dcn ==
+        # machine.num_hosts when a hybrid placement is being evaluated.
+        self.dp_dcn = 1
+        self.tp_dcn = 1
+
+    def set_axis_topology(self, dp_dcn: int = 1, tp_dcn: int = 1) -> None:
+        """Declare how the candidate mesh maps onto hosts: ``dp_dcn`` /
+        ``tp_dcn`` are the DCN-spanning subfactors of the data and model
+        axes. Collective costs for an axis with a DCN factor pay DCN
+        latency/bandwidth for the cross-host phase."""
+        self.dp_dcn = max(dp_dcn, 1)
+        self.tp_dcn = max(tp_dcn, 1)
+
+    def _nic_sharers(self, group_ici: int) -> int:
+        """Concurrent distinct collective groups per host sharing the NIC:
+        every chip of the host participates in some group; groups with
+        ``group_ici`` local members leave chips_per_host/group_ici distinct
+        groups contending for the host's DCN bandwidth."""
+        return max(self.machine.chips_per_host // max(group_ici, 1), 1)
 
     # ------------------------------------------------------------ per-op cost
     def op_cost(self, node: PCGNode, in_shapes: List[Tuple[int, ...]],
@@ -152,18 +198,34 @@ class Simulator:
         # backward ~ 2x forward for weight-bearing ops, 1x otherwise
         bwd = fwd * (2.0 if w_bytes else 1.0)
 
+        # DCN subfactors of each axis for the candidate being costed (clamped
+        # when this op's sharding does not span the full axis)
+        tp_dcn = self.tp_dcn if sh.tp % self.tp_dcn == 0 else 1
+        tp_ici = max(sh.tp // tp_dcn, 1)
+
         # intra-op collective: row-parallel / head-parallel psum of the output
         comm = 0.0
         if sh.kind in ("row", "heads", "table") and sh.tp > 1:
-            comm = m.allreduce_time(out_bytes // max(sh.dp, 1), sh.tp)
+            comm = m.hier_allreduce_time(
+                out_bytes // max(sh.dp, 1), tp_ici, tp_dcn,
+                nic_sharers=self._nic_sharers(tp_ici))
         elif sh.kind == "ring" and sh.tp > 1:
             # sequence parallel: cost the schedule the emission will pick
             # (ring k/v rotation or all-to-all head re-partition) so the
             # DP's numbers match the executed program
-            _, comm = sequence_schedule(node, in_shapes, sh, m)
+            _, comm = sequence_schedule(node, in_shapes, sh, m,
+                                        tp_dcn=tp_dcn)
         elif sh.kind == "expert" and sh.tp > 1:
             # expert parallel: all-to-all token exchange in and out
-            comm = 2 * m.alltoall_time(in_bytes // deg, sh.tp)
+            comm = 2 * m.hier_alltoall_time(
+                in_bytes // deg, tp_ici, tp_dcn,
+                nic_sharers=self._nic_sharers(tp_ici))
+
+        # every forward activation collective has a mirror in backward
+        # (Megatron's f/g conjugate operators; ring attention re-rotates k/v
+        # and reduces dk/dv; EP re-runs the token all-to-all) — the
+        # reference prices fwd and bwd comm separately (simulator.cc:489,537)
+        comm *= 2.0
 
         # gradient sync: weights replicated over dp -> allreduce over dp;
         # ring attention and pass-through SP states replicate weights over tp
@@ -171,7 +233,15 @@ class Simulator:
         sync = 0.0
         sync_n = sh.dp * (sh.tp if sh.kind == "ring" else sh.act_tp)
         if w_bytes and sync_n > 1:
-            sync = m.allreduce_time(w_bytes // w_div, sync_n)
+            spans_tp = sh.kind == "ring" or sh.act_tp > 1
+            sync_dcn = (self.dp_dcn if sh.dp % self.dp_dcn == 0 else 1) * \
+                (tp_dcn if spans_tp else 1)
+            if sync_n % sync_dcn != 0:
+                sync_dcn = 1
+            sync_ici = sync_n // sync_dcn
+            sync = m.hier_allreduce_time(
+                w_bytes // w_div, sync_ici, sync_dcn,
+                nic_sharers=self._nic_sharers(sync_ici))
 
         return CostMetrics(
             forward_time=fwd, backward_time=bwd, sync_time=sync,
@@ -195,11 +265,16 @@ class Simulator:
         if src_state == dst_state or tp <= 1:
             return 0.0
         per_chip = bytes_total // max(dp * tp, 1)
+        tp_dcn = self.tp_dcn if tp % self.tp_dcn == 0 else 1
+        tp_ici = max(tp // tp_dcn, 1)
+        sharers = self._nic_sharers(tp_ici)
         if dst_state == "R":
-            return self.machine.allgather_time(per_chip, tp)
+            return self.machine.hier_allgather_time(per_chip, tp_ici, tp_dcn,
+                                                    nic_sharers=sharers)
         if src_state == "R":
             return 0.0  # R->S / R->Q: local slice
-        return self.machine.alltoall_time(per_chip, tp)  # S<->Q
+        return self.machine.hier_alltoall_time(per_chip, tp_ici, tp_dcn,
+                                               nic_sharers=sharers)  # S<->Q
 
     # ------------------------------------------------------- whole-graph sim
     def simulate(self, pcg: PCG,
@@ -228,8 +303,8 @@ class Simulator:
             total_sync += cm.sync_time
             # activation memory: outputs + grads (x2), weights + opt state (x3)
             mem += cm.outputs_memory * 2 + cm.weights_memory * 4
-            # resharding on input edges
-            my_state = states.get(node.guid, "R")
+            # resharding on input edges (against the state the op consumes)
+            my_state = op_in_state(sh, states.get(node.guid, "R"))
             for g, i in node.inputs:
                 src = pcg.nodes[g]
                 if src.op.op_type in (OperatorType.OP_INPUT,
@@ -238,7 +313,8 @@ class Simulator:
                 src_state = states.get(g, "R")
                 nbytes = int(np.prod(src.out_shapes[i])) * size_of_datatype(
                     src.op.data_type)
-                total_comm += self.resharding_cost(
+                # x2: the backward pass runs the transposed resharding
+                total_comm += 2 * self.resharding_cost(
                     nbytes, src_state, my_state, sh.dp, sh.tp)
         if self.overlap:
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
@@ -282,7 +358,7 @@ class Simulator:
                 esrc.append(fwd)
                 edst.append(comm)
                 idx[node.guid] = comm  # consumers wait for the collective
-            my_state = states.get(node.guid, "R")
+            my_state = op_in_state(sh, states.get(node.guid, "R"))
             for g, i in node.inputs:
                 if g not in idx:
                     continue
@@ -295,7 +371,8 @@ class Simulator:
                     src_node = pcg.nodes[g]
                     nbytes = int(np.prod(src_node.out_shapes[i])) * \
                         size_of_datatype(src_node.op.data_type)
-                    xfer = self.resharding_cost(
+                    # x2: the backward pass runs the transposed resharding
+                    xfer = 2 * self.resharding_cost(
                         nbytes, src_state, my_state, sh.dp, sh.tp)
                     if xfer > 0:
                         r = add_task(xfer, 1)

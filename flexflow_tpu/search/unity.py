@@ -106,6 +106,28 @@ class SearchResult:
     mesh_shape: Tuple[int, int]
     pcg: Optional[PCG] = None          # rewritten graph (xfers applied)
     states: Optional[Dict[int, str]] = None
+    # (dp_dcn, tp_dcn): the DCN-spanning subfactor of each mesh axis on a
+    # multi-host machine ((1, 1) = single slice)
+    dcn: Tuple[int, int] = (1, 1)
+
+
+def dcn_placements(dp: int, tp: int, num_hosts: int
+                   ) -> List[Tuple[int, int]]:
+    """How the host factor can map onto a (dp, tp) mesh: every split
+    h_dp * h_tp == num_hosts with h_dp | dp and h_tp | tp. The DCN factor of
+    an axis must not split an ICI ring, so it is an outer factor (reference:
+    inter-node placement in EnhancedMachineModel; jax:
+    mesh_utils.create_hybrid_device_mesh's same constraint)."""
+    if num_hosts <= 1:
+        return [(1, 1)]
+    out = []
+    for h_dp in range(1, num_hosts + 1):
+        if num_hosts % h_dp:
+            continue
+        h_tp = num_hosts // h_dp
+        if dp % h_dp == 0 and tp % h_tp == 0:
+            out.append((h_dp, h_tp))
+    return out
 
 
 def factorizations(n: int) -> List[Tuple[int, int]]:
@@ -240,8 +262,9 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 for src_state, (po, pt, pm, _bp) in ptab.items():
                     if po >= INF:
                         continue
-                    xfer = sim.resharding_cost(nbytes, src_state, state,
-                                               dp, tp)
+                    # x2: the backward pass runs the transposed resharding
+                    xfer = 2 * sim.resharding_cost(nbytes, src_state, state,
+                                                   dp, tp)
                     cand = (po + mix(xfer, 0.0), pt + xfer, pm)
                     if best is None or cand[0] < best[0]:
                         best = cand
@@ -367,6 +390,14 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     stages = split_stages(pcg, pp)
     stage_of = {g: s for s, guids in enumerate(stages) for g in guids}
     sh = OpSharding(dp=dp)
+    # multi-host layout: stages are laid out contiguously over hosts. With
+    # pp >= hosts the dp groups stay within a host (sync on ICI) and
+    # hosts-1 stage boundaries cross DCN; with pp < hosts each stage spans
+    # hosts/pp hosts, so its dp gradient sync carries that DCN factor.
+    hosts = sim.machine.num_hosts
+    stage_dcn = max(hosts // pp, 1) if hosts > 1 else 1
+    if stage_dcn > 1 and dp % stage_dcn == 0:
+        sim.set_axis_topology(dp_dcn=stage_dcn)
     stage_t = [0.0] * pp
     stage_sync = [0.0] * pp
     stage_w = [0] * pp
@@ -395,13 +426,19 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
 
     specs = build_stage_specs(pcg, stages)
     comm = 0.0
-    el_bw = sim.machine.ici_bandwidth
+    stages_per_host = max(pp // hosts, 1)
     for s in range(pp - 1):
+        # boundary s->s+1 crosses DCN when the next stage starts a new host
+        crosses = hosts > 1 and pp >= hosts and \
+            (s + 1) % stages_per_host == 0
+        el_bw = sim.machine.dcn_bandwidth if crosses \
+            else sim.machine.ici_bandwidth
         for g, i in specs[s].outputs:
             node = pcg.nodes[g]
             nbytes = int(np.prod(node.out_shapes[i])) * \
                 size_of_datatype(node.op.data_type)
             comm += 2 * (nbytes / max(dp, 1)) / el_bw  # fwd + bwd hops
+    sim.set_axis_topology(1, 1)
     mem = max(2 * w + act // max(n_micro, 1)  # weights + grads + micro acts
               for w, act in zip(stage_w, stage_act))
     return bubble_time + comm + sync, mem
@@ -412,18 +449,25 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                            states: Dict[int, str], dp: int, tp: int,
                            data_axis: str = "data",
                            model_axis: str = "model",
-                           machine: Optional[TPUMachineModel] = None
-                           ) -> Strategy:
+                           machine: Optional[TPUMachineModel] = None,
+                           dcn: Tuple[int, int] = (1, 1)) -> Strategy:
     """Materialize the search result as weight/output shardings (the
     reference's convert_graph_to_operators + optimal_views). ``machine``
     enables sequence-schedule selection (ring vs alltoall) consistent with
-    the simulator's costs; without it the ring schedule is kept."""
+    the simulator's costs; without it the ring schedule is kept. ``dcn``
+    records each axis's DCN subfactor on a multi-host machine — the executor
+    builds the mesh via build_hybrid_mesh so the DCN factor never splits an
+    ICI ring."""
     if tp == 1:
         s = Strategy(mesh_shape=(dp,), axis_names=(data_axis,),
                      data_axis=data_axis)
+        if dcn[0] > 1:
+            s.hybrid = ((dp // dcn[0],), (dcn[0],))
     else:
         s = Strategy(mesh_shape=(dp, tp), axis_names=(data_axis, model_axis),
                      data_axis=data_axis)
+        if dcn != (1, 1):
+            s.hybrid = ((dp // dcn[0], tp // dcn[1]), tuple(dcn))
     view = MachineView(dim=(dp, tp) if tp > 1 else (dp,),
                        stride=(tp, 1) if tp > 1 else (1,))
 
@@ -479,7 +523,7 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
                     in_shapes = [pcg.nodes[g].out_shapes[i]
                                  for g, i in node.inputs]
                     sched, _ = sequence_schedule(node, in_shapes, sh,
-                                                 machine)
+                                                 machine, tp_dcn=dcn[1])
                     if sched != "ring":
                         ns.extra["sequence_parallel_mode"] = sched
                 ns.output_spec = state_spec("Q", ndim)
@@ -540,7 +584,10 @@ def insert_parallel_ops(pcg: PCG, assignment: Dict[int, OpSharding],
             continue
         shape = node.out_shapes[0]
         nbytes = int(np.prod(shape)) * size_of_datatype(node.op.data_type)
-        cost = sim.machine.allreduce_time(nbytes // max(dp, 1), tp)
+        tp_dcn = sim.tp_dcn if tp % sim.tp_dcn == 0 else 1
+        cost = sim.machine.hier_allreduce_time(
+            nbytes // max(dp, 1), tp // tp_dcn, tp_dcn,
+            nic_sharers=sim._nic_sharers(tp // tp_dcn))
         op = op_class_for(OperatorType.OP_REDUCTION)(
             f"reduction_{node.guid}",
             {"dim": 0, "degree": tp, "axes": (model_axis,),
@@ -622,20 +669,9 @@ def insert_parallel_ops(pcg: PCG, assignment: Dict[int, OpSharding],
 def _in_state_of(node: PCGNode, assignment: Dict[int, OpSharding],
                  states: Dict[int, str]) -> str:
     """The input state the node's chosen option consumes."""
-    sh = assignment.get(node.guid)
-    st = states.get(node.guid, "R")
-    if sh is None:
-        return "R"
-    if sh.kind in ("col",):
-        return "R"
-    if sh.kind == "row":
-        return "S"
-    if sh.kind == "ring":
-        return "Q"
-    if sh.kind in ("heads", "table", "expert"):
-        return "R"
-    # state-preserving: input state == output state
-    return st
+    from .simulator import op_in_state
+
+    return op_in_state(assignment.get(node.guid), states.get(node.guid, "R"))
 
 
 # ------------------------------------------------------------ best-first xfers
@@ -805,20 +841,27 @@ def unity_search(pcg: PCG, config, n_dev: int,
         for dp, tp in factorizations(n_dev):
             if batch % dp != 0:
                 continue
-            g, a, s, t = best_first_optimize(
-                base_pcg, sim, dp, tp, batch, xfers,
-                budget=max(budget // 4, 4), alpha=alpha, space=space,
-                lam=lam, protected_guids=protected_guids,
-                split_threshold=getattr(config, "base_optimize_threshold",
-                                        0))
-            _, mem = sim.simulate(g, a, s)
-            _log.info("mesh dp=%d tp=%d lam=%.2f -> %.3f ms, %.1f MiB/chip",
-                      dp, tp, lam, t * 1e3, mem / 2 ** 20)
-            results.append(SearchResult(
-                strategy=assignment_to_strategy(g, a, s, dp, tp,
-                                                machine=machine),
-                assignment=a, sim_time=t, sim_memory=mem,
-                mesh_shape=(dp, tp), pcg=g, states=s))
+            for dp_dcn, tp_dcn in dcn_placements(dp, tp, machine.num_hosts):
+                sim.set_axis_topology(dp_dcn, tp_dcn)
+                g, a, s, t = best_first_optimize(
+                    base_pcg, sim, dp, tp, batch, xfers,
+                    budget=max(budget // 4, 4), alpha=alpha, space=space,
+                    lam=lam, protected_guids=protected_guids,
+                    split_threshold=getattr(config,
+                                            "base_optimize_threshold", 0))
+                _, mem = sim.simulate(g, a, s)
+                _log.info(
+                    "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f -> %.3f ms, "
+                    "%.1f MiB/chip", dp, tp, dp_dcn, tp_dcn, lam, t * 1e3,
+                    mem / 2 ** 20)
+                results.append(SearchResult(
+                    strategy=assignment_to_strategy(
+                        g, a, s, dp, tp, machine=machine,
+                        dcn=(dp_dcn, tp_dcn)),
+                    assignment=a, sim_time=t, sim_memory=mem,
+                    mesh_shape=(dp, tp), pcg=g, states=s,
+                    dcn=(dp_dcn, tp_dcn)))
+        sim.set_axis_topology(1, 1)
         if not results:
             return None
         if mem_budget is not None:
@@ -898,8 +941,10 @@ def unity_search(pcg: PCG, config, n_dev: int,
         pcg._order = best.pcg._order
     if insert_ir_nodes and best.states is not None:
         dp, tp = best.mesh_shape
+        sim.set_axis_topology(*best.dcn)  # annotate at the winner's topology
         insert_parallel_ops(pcg, best.assignment, best.states, best.strategy,
                             sim, dp, tp)
+        sim.set_axis_topology(1, 1)
     return (best if return_result else best.strategy)
 
 
