@@ -42,11 +42,11 @@ def _mnist_load_data(path: str = "mnist.npz"):
     return (x_train, y_train), (x_test, y_test)
 
 
-def _cifar10_load_data(num_samples=None):
-    """reference: datasets/cifar10.py load_data(num_samples) — returns
-    channels-first (50000, 3, 32, 32) uint8 train / (10000, 3, 32, 32)
-    test, truncated to num_samples train rows when given (the examples
-    call cifar10.load_data(10000))."""
+def _cifar10_load_data(num_samples=40000):
+    """reference: datasets/cifar10.py load_data(num_samples=40000) — returns
+    channels-first (num_samples, 3, 32, 32) uint8 train / (10000, 3, 32, 32)
+    test, truncated to num_samples train rows (the examples call
+    cifar10.load_data(10000)); same 40000-row default as the reference."""
     (tr, te) = _cifar10_load_all()
     if num_samples is not None:
         tr = (tr[0][:num_samples], tr[1][:num_samples])

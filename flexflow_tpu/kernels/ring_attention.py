@@ -54,7 +54,10 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
     """
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level alias
+    except ImportError:  # older jax on pinned TPU stacks
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_seq = mesh.shape[seq_axis]

@@ -45,7 +45,10 @@ def ulysses_attention(q, k, v, mesh, seq_axis: str = "seq",
     Must be called under jit with ``mesh``; returns the attention output
     with the same sharding as q."""
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level alias
+    except ImportError:  # older jax on pinned TPU stacks
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_seq = mesh.shape[seq_axis]

@@ -671,12 +671,18 @@ class FFModel:
                 # factor so the search prices inter-node collectives
                 # (reference: EnhancedMachineModel, simulator.h:212-606)
                 machine = None
-                if nodes > 1 and n_search % nodes == 0 and \
-                        not self.config.machine_model_file:
+                # unity_search only reads the file when version == 1, so a
+                # file set under version 0 must not suppress the detected
+                # multi-node model (it would silently drop the host factor)
+                file_used = (self.config.machine_model_version == 1
+                             and self.config.machine_model_file)
+                if nodes > 1 and n_search % nodes == 0 and not file_used:
                     from .search.machine_model import TPUMachineModel
 
-                    machine = TPUMachineModel.detect(n_search)
-                    machine.num_hosts = nodes
+                    # num_hosts at construction so the per-slice torus
+                    # invariant (prod == chips per slice) holds
+                    machine = TPUMachineModel.detect(n_search,
+                                                     num_hosts=nodes)
                 target_pcg = pcg.copy()
                 strat = unity_search(target_pcg, self.config, n_search,
                                      machine=machine,
@@ -957,9 +963,16 @@ class FFModel:
         self._staged.pop("grads", None)
 
     def backward(self, seq_length: Optional[int] = None) -> None:
+        self._ensure_staged_batch()
+        assert self._staged.get("batch") is not None, \
+            "bind a batch first via next_batch/set_batch/set_tensor"
+        if self._staged.get("label_placeholder"):
+            raise RuntimeError(
+                "backward() needs a real label batch: stage one via "
+                "label_tensor.set_tensor(...) or set_batch(x, y) — refusing "
+                "to train against the zero placeholder")
         import jax
 
-        self._ensure_staged_batch()
         xs, y = self._staged["batch"]
 
         from .ops.base import OpContext
@@ -986,6 +999,7 @@ class FFModel:
 
         xs = [jax.device_put(np.asarray(a)) for a in self._as_input_list(x)]
         self._staged["batch"] = (xs, jax.device_put(self._prep_label(y)))
+        self._staged["label_placeholder"] = False  # y is a real label
 
     def _stage_tensor_value(self, tensor, np_array) -> None:
         """Tensor.set_tensor host staging (reference:
@@ -1004,15 +1018,45 @@ class FFModel:
         if not all(t.guid in per for t in self._input_tensors):
             return  # forward() will assert if nothing was ever bound
         xs = [per[t.guid] for t in self._input_tensors]
+        placeholder = False
         if self.label_tensor is not None and self.label_tensor.guid in per:
             y = per[self.label_tensor.guid]
         elif self.label_tensor is not None:
+            # forward-only staging: a zero placeholder keeps forward()
+            # usable, but backward() refuses to train on it (below)
             y = np.zeros(self.label_tensor.dims,
                          dtype=dtype_to_jnp(self.label_tensor.dtype))
+            placeholder = True
         else:
             return
         self.set_batch(xs, y)
+        self._staged["label_placeholder"] = placeholder
         self._staged["per_tensor_dirty"] = False
+
+    def _activation_value(self, tensor) -> np.ndarray:
+        """get_tensor on an activation output: recompute forward on the
+        staged batch and return that layer's output (reference analog:
+        inline-mapping an output region, flexflow_cffi.py:601-658)."""
+        from .ops.base import OpContext
+
+        self._ensure_staged_batch()
+        assert self._staged.get("batch") is not None, \
+            f"bind a batch before reading activation {tensor.name}"
+        xs, _ = self._staged["batch"]
+        guid = self._tensor_to_node.get(tensor.guid)
+        import jax
+
+        # constant key: a read-only getter must not advance the training
+        # rng stream (rng is unused under training=False anyway)
+        vals = self.executor.forward_outputs(
+            self.params, self.executor._bind_inputs(xs),
+            OpContext(training=False, rng=jax.random.PRNGKey(0),
+                      mesh=self.mesh))
+        if guid not in vals:
+            raise KeyError(
+                f"{tensor.name}: its op was fused away; re-compile with "
+                "--disable-fusion to inline-read intermediate activations")
+        return np.asarray(vals[guid][tensor.owner_idx])
 
     def _staged_tensor_value(self, tensor) -> np.ndarray:
         per = self._staged.get("per_tensor", {})

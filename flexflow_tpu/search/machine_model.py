@@ -81,8 +81,11 @@ class TPUMachineModel:
                 if "=" in line:
                     k, v = line.split("=", 1)
                     kv[k.strip()] = v.strip()
+        # num_hosts feeds the default-torus computation (invariant:
+        # prod(torus) == chips per slice), so parse it BEFORE construction
+        num_hosts = int(kv.get("num_hosts", 1))
         m = TPUMachineModel.from_generation(kv.get("generation", "v5e"),
-                                            num_chips)
+                                            num_chips, num_hosts=num_hosts)
         for field in ("peak_flops", "hbm_bandwidth", "ici_bandwidth",
                       "dcn_bandwidth", "ici_latency", "dcn_latency",
                       "matmul_efficiency", "hbm_efficiency"):
@@ -90,14 +93,21 @@ class TPUMachineModel:
                 setattr(m, field, float(kv[field]))
         if "hbm_capacity" in kv:
             m.hbm_capacity = int(float(kv["hbm_capacity"]))
-        if "num_hosts" in kv:
-            m.num_hosts = int(kv["num_hosts"])
         if "torus" in kv:
             m.torus = tuple(int(x) for x in kv["torus"].split("x"))
         return m
 
+    def set_num_hosts(self, num_hosts: int) -> "TPUMachineModel":
+        """Re-split the machine into ``num_hosts`` DCN-connected slices,
+        recomputing the per-slice torus (mutating ``num_hosts`` directly
+        would leave ``torus`` spanning the whole machine)."""
+        self.num_hosts = max(num_hosts, 1)
+        self.torus = _default_torus(self.chips_per_host)
+        return self
+
     @staticmethod
-    def detect(num_chips: Optional[int] = None) -> "TPUMachineModel":
+    def detect(num_chips: Optional[int] = None,
+               num_hosts: Optional[int] = None) -> "TPUMachineModel":
         """Build from the visible JAX devices (CPU test mesh gets v5e params
         so search decisions are deterministic on CI)."""
         import os
@@ -108,7 +118,8 @@ class TPUMachineModel:
         n = num_chips or len(devs)
         # multi-host runs: each process owns one slice's worth of chips, so
         # the DCN factor is the process count (hosts == slices here)
-        hosts = jax.process_count() if n == len(devs) else 1
+        hosts = num_hosts or \
+            (jax.process_count() if n == len(devs) else 1)
         hosts = hosts if n % max(hosts, 1) == 0 else 1
         kind = devs[0].device_kind.lower()
         for gen in TPU_GENERATIONS:
@@ -137,14 +148,46 @@ class TPUMachineModel:
                     self.dcn_latency)
         return (self.ici_bandwidth * links, self.ici_latency)
 
+    def _ici_ring(self, num_participants: int) -> Tuple[int, int]:
+        """(usable links, per-round latency hops) for a ring collective over
+        ``num_participants`` chips laid out contiguously on the ICI torus.
+
+        Torus-aware analog of the reference's topology-driven routing
+        (NetworkedMachineModel topology generators + routing strategies,
+        include/flexflow/simulator.h:383-606, src/runtime/network.cc): a
+        group spanning k torus axes runs k concurrent bidirectional rings
+        (2k links per chip), and the ring phases are per-axis, so the hop
+        count is the sum of axis extents, not the flat group size."""
+        rem = max(num_participants, 1)
+        axes = 0
+        hops = 0
+        for d in self.torus:
+            if rem <= 1 or rem % d:
+                break
+            axes += 1
+            hops += d - 1
+            rem //= d
+        if rem > 1:
+            # leftover that doesn't fill an axis rides a single embedded
+            # ring — extra hops, no extra concurrent rings
+            hops += rem - 1
+        links = min(2 * max(axes, 1), self.ici_links_per_chip)
+        return links, max(hops, 1)
+
     def allreduce_time(self, bytes_per_chip: int, num_participants: int,
                        medium: str = "ici", nic_sharers: int = 1) -> float:
         """Ring all-reduce: 2*(n-1)/n * bytes over the per-chip link
-        bandwidth (bidirectional ICI rings use two links)."""
+        bandwidth. On ICI the torus shape decides how many bidirectional
+        rings run concurrently (one per spanned axis — 2 links each)."""
         if num_participants <= 1 or bytes_per_chip == 0:
             return 0.0
-        eff_bw, lat = self._link(medium, nic_sharers,
-                                 min(self.ici_links_per_chip, 2))
+        if medium == "ici":
+            links, hops = self._ici_ring(num_participants)
+            eff_bw, lat = self._link(medium, nic_sharers, links)
+            n = num_participants
+            return (lat * 2 * hops
+                    + 2 * (n - 1) / n * bytes_per_chip / eff_bw)
+        eff_bw, lat = self._link(medium, nic_sharers, 2)
         steps = 2 * (num_participants - 1)
         return (lat * steps
                 + steps / num_participants * bytes_per_chip / eff_bw)
@@ -153,8 +196,13 @@ class TPUMachineModel:
                        medium: str = "ici", nic_sharers: int = 1) -> float:
         if num_participants <= 1 or bytes_per_chip == 0:
             return 0.0
-        eff_bw, lat = self._link(medium, nic_sharers,
-                                 min(self.ici_links_per_chip, 2))
+        if medium == "ici":
+            links, hops = self._ici_ring(num_participants)
+            eff_bw, lat = self._link(medium, nic_sharers, links)
+            n = num_participants
+            return (lat * hops
+                    + (n - 1) * bytes_per_chip / eff_bw)
+        eff_bw, lat = self._link(medium, nic_sharers, 2)
         steps = num_participants - 1
         return (lat * steps
                 + steps * bytes_per_chip / eff_bw)

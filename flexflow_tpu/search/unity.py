@@ -330,15 +330,27 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     return assignment, states, sim_time
 
 
+_warned_once: Set[str] = set()
+
+
+def _warn_once(key: str, msg: str, *args) -> None:
+    if key not in _warned_once:
+        _warned_once.add(key)
+        _log.warning(msg, *args)
+
+
 def simulate_best(sim: Simulator, pcg: PCG,
                   assignment: Dict[int, OpSharding],
                   states: Dict[int, str]) -> float:
     """Event-driven makespan via the native core (reference:
     simulate_runtime's per-device timelines); falls back to the additive
-    model when the C++ extension is unavailable."""
+    model only when the C++ extension is unavailable — a native-core
+    runtime bug propagates rather than silently re-ranking candidates."""
     try:
         return sim.simulate_event_driven(pcg, assignment, states)
-    except Exception:
+    except (ImportError, OSError) as e:
+        _warn_once("native-sim", "native task-graph core unavailable (%s); "
+                   "falling back to the additive cost model", e)
         return sim.simulate(pcg, assignment, states)[0]
 
 
@@ -355,12 +367,29 @@ def pipeline_microbatch_safe(pcg: PCG, batch: int) -> bool:
         if ot in unsafe_types:
             return False
         if ot == OperatorType.OP_RESHAPE and batch > 1:
-            tgt = n.op.attrs.get("shape", ())
-            # an explicit LEADING dim divisible by the batch is
-            # batch-derived — (b, 5, 16), (b*seq, vocab); trailing dims
-            # that merely share a factor (heads, hidden) are fine
-            if tgt and isinstance(tgt[0], (int, np.integer)) and \
+            tgt = tuple(n.op.attrs.get("shape", ()))
+            in_shape = (pcg.nodes[n.inputs[0][0]].out_shapes[n.inputs[0][1]]
+                        if n.inputs else ())
+            if tgt and in_shape and batch in in_shape:
+                # the input carries the batch: an all-explicit target bakes
+                # the global batch volume (ReshapeOp asserts on a
+                # microbatch), and a -1 wildcard anywhere but the leading
+                # batch position silently absorbs the microbatch factor
+                # into the wrong dim
+                wild = [i for i, d in enumerate(tgt) if d == -1]
+                if not wild:
+                    return False
+                per_sample = max(int(np.prod(in_shape)) // batch, 1)
+                rest = int(np.prod([d for d in tgt if d != -1])) \
+                    if len(tgt) > 1 else 1
+                if in_shape[0] != batch or wild[0] != 0 or \
+                        (rest > 0 and per_sample % rest):
+                    return False
+            elif tgt and isinstance(tgt[0], (int, np.integer)) and \
                     tgt[0] > 0 and tgt[0] % batch == 0:
+                # input batch dim already merged away (e.g. (b*s, h)): an
+                # explicit leading batch-derived target — the unflatten
+                # back to (b, s, h) — still bakes the global batch
                 return False
         if ot == OperatorType.OP_SLICE:
             items = n.op.attrs.get("items", ())
@@ -522,8 +551,12 @@ def assignment_to_strategy(pcg: PCG, assignment: Dict[int, OpSharding],
 
                     in_shapes = [pcg.nodes[g].out_shapes[i]
                                  for g, i in node.inputs]
+                    # same divisibility clamp as Simulator.op_cost, so the
+                    # emitted schedule is chosen at the costed topology
+                    tp_dcn = dcn[1] if dcn[1] > 0 and \
+                        sh.tp % dcn[1] == 0 else 1
                     sched, _ = sequence_schedule(node, in_shapes, sh,
-                                                 machine, tp_dcn=dcn[1])
+                                                 machine, tp_dcn=tp_dcn)
                     if sched != "ring":
                         ns.extra["sequence_parallel_mode"] = sched
                 ns.output_spec = state_spec("Q", ndim)
@@ -693,7 +726,12 @@ def apply_all_matches(pcg: PCG, xfers,
                     continue
                 try:
                     g = xfer.apply(g, match)
-                except Exception:
+                except (ValueError, KeyError) as e:
+                    # structurally inapplicable match (shape/attr mismatch
+                    # only visible at apply time) — skip, but say so once
+                    _warn_once(f"xfer-apply:{xfer.name}",
+                               "xfer %s: match not applicable (%s)",
+                               xfer.name, e)
                     continue
                 applied += 1
                 changed = True
@@ -760,7 +798,10 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                     continue  # spans a split point
                 try:
                     g2 = xfer.apply(g, match)
-                except Exception:
+                except (ValueError, KeyError) as e:
+                    _warn_once(f"xfer-apply:{xfer.name}",
+                               "xfer %s: match not applicable (%s)",
+                               xfer.name, e)
                     continue
                 h = g2.hash()
                 if h in seen:

@@ -77,14 +77,21 @@ class Tensor:
         model = ff_model or self.model
         if self.owner_layer is None or self is model.label_tensor:
             model._stage_tensor_value(self, np_array)
-        else:
+        elif self.owner_idx < 0:
             model._set_weight_by_tensor(self, np_array)
+        else:
+            raise ValueError(
+                f"{self.name} is an activation output of layer "
+                f"'{self.owner_layer.name}'; set_tensor accepts model "
+                "inputs, the label tensor, or weight tensors")
 
     def get_tensor(self, ff_model=None, comm_type=None) -> np.ndarray:
         model = ff_model or self.model
         if self.owner_layer is None or self is model.label_tensor:
             return model._staged_tensor_value(self)
-        return model._get_weight_by_tensor(self)
+        if self.owner_idx < 0:
+            return model._get_weight_by_tensor(self)
+        return model._activation_value(self)
 
     def attach_numpy_array(self, ff_model, ff_config=None,
                            np_array: Optional[np.ndarray] = None) -> None:

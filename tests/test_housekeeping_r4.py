@@ -1,0 +1,116 @@
+"""Round-4 housekeeping fixes (VERDICT r3 weak #8/#9, ADVICE r2+r3 lows):
+activation-output set_tensor/get_tensor semantics, zero-label training
+refusal, input-shape-aware reshape microbatch guard, cifar10 default."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+
+def _compiled_mlp(batch=4, din=8, dout=3):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, din))
+    h = ff.dense(x, 16, ActiMode.AC_MODE_RELU, name="hidden")
+    ff.dense(h, dout, name="out")
+    ff.compile(optimizer=SGDOptimizer(ff, 0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, x, h
+
+
+def test_set_tensor_on_activation_raises():
+    """ADVICE r2: used to fall into the weight path and hit its assert with
+    a misleading message."""
+    ff, x, h = _compiled_mlp()
+    with pytest.raises(ValueError, match="activation output"):
+        h.set_tensor(ff, np.zeros(h.dims, np.float32))
+
+
+def test_get_tensor_on_activation_returns_forward_value():
+    ff, x, h = _compiled_mlp()
+    xv = np.random.default_rng(0).normal(size=x.dims).astype(np.float32)
+    x.set_tensor(ff, xv)
+    got = h.get_tensor(ff)
+    assert got.shape == h.dims
+    # spot-check against a manual dense+relu with the live weights
+    p = ff.params[h.owner_layer.name]
+    ref = np.maximum(xv @ np.asarray(p["kernel"]) + np.asarray(p["bias"]), 0)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_backward_refuses_zero_label_placeholder():
+    """ADVICE r2: _ensure_staged_batch used to silently zero-fill missing
+    labels on the training path — a corrupted run, not an error."""
+    ff, x, h = _compiled_mlp()
+    x.set_tensor(ff, np.zeros(x.dims, np.float32))
+    ff.forward()  # forward-only use of the placeholder stays legal
+    with pytest.raises(RuntimeError, match="label"):
+        ff.backward()
+    # staging a real label unblocks training
+    ff.label_tensor.set_tensor(
+        ff, np.zeros(ff.label_tensor.dims, np.int32))
+    ff.backward()
+    ff.update()
+
+
+def test_set_batch_clears_placeholder_flag():
+    """A real label staged via set_batch after forward-only staging must
+    unblock backward (the RuntimeError recommends exactly this remedy)."""
+    ff, x, h = _compiled_mlp()
+    x.set_tensor(ff, np.zeros(x.dims, np.float32))
+    ff.forward()
+    with pytest.raises(RuntimeError, match="label"):
+        ff.backward()
+    ff.set_batch(np.zeros(x.dims, np.float32),
+                 np.zeros(ff.label_tensor.dims, np.int32))
+    ff.backward()
+
+
+def _guard_pcg(build):
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    build(ff)
+    return ff.create_pcg()
+
+
+def test_reshape_guard_catches_nonleading_and_wildcard_cases():
+    """ADVICE r2: the guard only caught explicit LEADING batch-divisible
+    dims. Now input-shape-aware: all-explicit targets of batch-carrying
+    tensors (ReshapeOp would assert on a microbatch) and wildcards that
+    absorb the microbatch factor into the wrong dim are both unsafe;
+    the per-sample flatten stays safe."""
+    from flexflow_tpu.search.unity import pipeline_microbatch_safe
+
+    # all-explicit, batch factor split across non-leading dims
+    pcg = _guard_pcg(lambda ff: ff.dense(ff.flat(ff.reshape(
+        ff.create_tensor((8, 80)), (5, 8, 16))), 4))
+    assert not pipeline_microbatch_safe(pcg, 8)
+
+    # wildcard in a non-leading slot silently absorbs the microbatch factor
+    pcg = _guard_pcg(lambda ff: ff.dense(ff.flat(ff.reshape(
+        ff.create_tensor((8, 80)), (8, -1, 16))), 4))
+    assert not pipeline_microbatch_safe(pcg, 8)
+
+    # unflatten of a merged batch dim: input (b*s, h) no longer contains
+    # the literal batch, but the explicit (b, s, h) target still bakes it
+    pcg = _guard_pcg(lambda ff: ff.dense(ff.flat(ff.reshape(ff.reshape(
+        ff.create_tensor((8, 4, 20)), (-1, 20)), (8, 4, 20))), 4))
+    assert not pipeline_microbatch_safe(pcg, 8)
+
+    # the classic per-sample flatten is safe
+    pcg = _guard_pcg(lambda ff: ff.dense(ff.reshape(
+        ff.create_tensor((8, 4, 20)), (-1, 80)), 4))
+    assert pipeline_microbatch_safe(pcg, 8)
+
+
+def test_cifar10_default_num_samples_matches_reference():
+    """reference: python/flexflow/keras/datasets/cifar10.py
+    load_data(num_samples=40000)."""
+    from flexflow_tpu.frontends.keras_datasets import cifar10
+
+    (x_train, y_train), (x_test, y_test) = cifar10.load_data()
+    assert x_train.shape == (40000, 3, 32, 32)
+    assert y_train.shape == (40000, 1)
+    assert x_test.shape == (10000, 3, 32, 32)
