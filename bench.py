@@ -141,11 +141,13 @@ def main():
         "model_flops_per_step": flops_per_step,
     }
     if on_tpu:
-        result.update(cost_model_checks(ff, config, dt))
+        result.update(cost_model_checks(ff, config, dt,
+                                        example_batch=(xd, yd)))
     print(json.dumps(result))
 
 
-def cost_model_checks(ff, config, measured_step_s: float) -> dict:
+def cost_model_checks(ff, config, measured_step_s: float,
+                      example_batch=None) -> dict:
     """(a) Ground the analytical cost model with on-device per-op
     measurements and check the simulated step time is within 2x of the
     measured one (reference: Simulator::measure_operator_cost ground truth,
@@ -170,14 +172,39 @@ def cost_model_checks(ff, config, measured_step_s: float) -> dict:
         out["sim_step_ms"] = round(sim_t * 1e3, 2)
         out["sim_vs_measured"] = round(sim_t / measured_step_s, 3)
         out["sim_calibrated_ops"] = n_cal
+        out["sim_bwd_calibrated_ops"] = len(sim._key_bwd_ratio)
+        out["sim_bwd_ratios"] = {
+            str(k[0][0]): round(v, 3)
+            for k, v in list(sim._key_bwd_ratio.items())[:8]}
         out["sim_within_2x"] = bool(
             0.5 <= sim_t / measured_step_s <= 2.0)
+
+        # memory model vs XLA ground truth (reference: graph.cc:1984-2032
+        # validates against the real framebuffer budget): compare the
+        # analytic outputs*2+weights*4 peak with the compiled step's
+        # peak_memory_in_bytes for the SAME (dp=1) strategy
+        try:  # own guard: must not sink the searched-vs-DP legs below
+            if example_batch is not None:
+                xd, yd = example_batch
+                _, mem_analytic = sim.simulate(pcg, dp1, {})
+                ma = ff.executor.train_step_memory_analysis(
+                    ff.params, ff.opt_state, xd, yd)
+                xla_peak = int(ma.peak_memory_in_bytes) if ma else 0
+                if xla_peak > 0:
+                    out["mem_analytic_mb"] = round(
+                        mem_analytic / 2 ** 20, 1)
+                    out["mem_xla_peak_mb"] = round(xla_peak / 2 ** 20, 1)
+                    out["mem_analytic_vs_xla"] = round(
+                        mem_analytic / xla_peak, 3)
+        except Exception as e:
+            out["mem_check_error"] = f"{type(e).__name__}: {e}"[:160]
 
         # searched vs DP at 8 chips on the device-calibrated model (the
         # calibrated simulator must be the one the search costs with)
         machine8 = TPUMachineModel.detect(8)
         sim8 = Simulator(machine8)
         sim8._key_calibration = dict(sim._key_calibration)
+        sim8._key_bwd_ratio = dict(sim._key_bwd_ratio)
         res = unity_search(pcg.copy(), config, 8, machine=machine8,
                            return_result=True, insert_ir_nodes=False,
                            sim=sim8)

@@ -270,6 +270,22 @@ class Executor:
         self._train_step = jax.jit(step, **jit_kwargs)
         return self._train_step
 
+    def train_step_memory_analysis(self, params, opt_state, xs, labels):
+        """XLA's compiled memory stats for the full training step
+        (jax.stages.Compiled.memory_analysis) — the ground truth the
+        analytic ``outputs*2 + weights*4`` model is validated against
+        (reference: per-device memory validation vs the framebuffer budget,
+        src/runtime/graph.cc:1984-2032). Returns the CompiledMemoryStats
+        object (``peak_memory_in_bytes`` is the headline number)."""
+        import jax
+
+        step = self.make_train_step()
+        rng = jax.random.PRNGKey(0)
+        args = (params, opt_state, xs, labels, rng)
+        if self.cache_nodes:
+            args = args + (self.init_cache(),)
+        return step.lower(*args).compile().memory_analysis()
+
     def _compute_metrics(self, logits, labels):
         if not self.metrics:
             return {}

@@ -162,6 +162,8 @@ class TPUMachineModel:
         axes = 0
         hops = 0
         for d in self.torus:
+            if d <= 1:
+                continue  # degenerate axis: no ring exists along it
             if rem <= 1 or rem % d:
                 break
             axes += 1

@@ -44,13 +44,14 @@ class CostMetrics:
     backward_time: float = 0.0
     sync_time: float = 0.0  # gradient allreduce
     comm_time: float = 0.0  # activation resharding
+    update_time: float = 0.0  # optimizer step (HBM-bound elementwise)
     inputs_memory: int = 0
     outputs_memory: int = 0
     weights_memory: int = 0
 
     def total_time(self) -> float:
         return (self.forward_time + self.backward_time + self.sync_time
-                + self.comm_time)
+                + self.comm_time + self.update_time)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +145,16 @@ class Simulator:
         # cost cache of simulator.cc:489; here per op-shape, scaled
         # analytically across shardings)
         self._key_calibration: Dict[Tuple, float] = {}
+        # per-op-key MEASURED backward/forward ratios (reference times
+        # backward explicitly: inner_measure_operator_cost runs both
+        # directions, simulator.cc:537 / model.cu:38). Keys absent here
+        # fall back to the analytical 2x/1x heuristic.
+        self._key_bwd_ratio: Dict[Tuple, float] = {}
+        # optimizer-update HBM traffic per weight byte: Adam-style reads
+        # w+g+m+v and writes w+m+v -> ~7 bytes moved per weight byte
+        # (reference: optimizer_kernel.cu adam_update_task). Set 0 to price
+        # bare SGD (in-place w -= lr*g streams ~3x).
+        self.update_bytes_factor = 7.0
         self._dispatch_overhead: Optional[float] = None
         # which mesh axis carries the machine's DCN factor for the candidate
         # being costed (reference: intra- vs inter-node pricing in
@@ -192,11 +203,14 @@ class Simulator:
         else:
             compute = shard_flops / (m.peak_flops_f32 * m.matmul_efficiency)
         mem_time = shard_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
-        cal = self._key_calibration.get(self._op_key(node, in_shapes),
-                                        self.calibration)
+        key = self._op_key(node, in_shapes)
+        cal = self._key_calibration.get(key, self.calibration)
         fwd = max(compute, mem_time) * cal
-        # backward ~ 2x forward for weight-bearing ops, 1x otherwise
-        bwd = fwd * (2.0 if w_bytes else 1.0)
+        # backward: measured per-key ratio when calibrated on device
+        # (calibrate_from_pcg times value_and_grad standalone); analytical
+        # 2x/1x heuristic otherwise
+        bwd = fwd * self._key_bwd_ratio.get(
+            key, 2.0 if w_bytes else 1.0)
 
         # DCN subfactors of each axis for the candidate being costed (clamped
         # when this op's sharding does not span the full axis)
@@ -243,9 +257,18 @@ class Simulator:
                 w_bytes // w_div, sync_ici, sync_dcn,
                 nic_sharers=self._nic_sharers(sync_ici))
 
+        # optimizer step: elementwise over this op's weight shard, HBM-bound
+        # (reference prices update explicitly via optimizer kernels,
+        # src/runtime/optimizer_kernel.cu) — at BERT-Large scale Adam moves
+        # ~7x the weight bytes and is a double-digit % of the step
+        update = 0.0
+        if w_bytes:
+            update = (self.update_bytes_factor * w_bytes / w_div
+                      / (m.hbm_bandwidth * m.hbm_efficiency))
+
         return CostMetrics(
             forward_time=fwd, backward_time=bwd, sync_time=sync,
-            comm_time=comm,
+            comm_time=comm, update_time=update,
             inputs_memory=int(in_bytes / deg),
             outputs_memory=int(out_bytes / deg),
             weights_memory=int(w_bytes / w_div))
@@ -289,6 +312,7 @@ class Simulator:
         total_comm = 0.0
         total_sync = 0.0
         total_bwd = 0.0
+        total_update = 0.0
         mem = 0
         states = states or {}
         el_cache: Dict[int, CostMetrics] = {}
@@ -301,6 +325,7 @@ class Simulator:
             total_bwd += cm.backward_time
             total_comm += cm.comm_time
             total_sync += cm.sync_time
+            total_update += cm.update_time
             # activation memory: outputs + grads (x2), weights + opt state (x3)
             mem += cm.outputs_memory * 2 + cm.weights_memory * 4
             # resharding on input edges (against the state the op consumes)
@@ -318,7 +343,8 @@ class Simulator:
                     nbytes, src_state, my_state, sh.dp, sh.tp)
         if self.overlap:
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
-        return total_compute + total_comm + total_sync, mem
+        return (total_compute + total_comm + total_sync + total_update,
+                mem)
 
     def simulate_event_driven(self, pcg: PCG,
                               assignment: Dict[int, OpSharding],
@@ -394,10 +420,18 @@ class Simulator:
                 esrc.append(idx[nodes[-1].guid])
                 edst.append(bwd)
             bwd_prev = bwd
+            last = bwd
             if cm.sync_time > 0:
                 sync = add_task(cm.sync_time, 1)
                 esrc.append(bwd)
                 edst.append(sync)
+                last = sync
+            if cm.update_time > 0:
+                # optimizer update streams HBM on the compute stream once
+                # the (synced) grads are ready
+                upd = add_task(cm.update_time, 0)
+                esrc.append(last)
+                edst.append(upd)
         return simulate_taskgraph(
             np.asarray(costs), np.asarray(devs), 2,
             np.asarray(esrc, dtype=np.int32),
@@ -436,14 +470,33 @@ class Simulator:
             if t > 0:
                 self._key_calibration[key] = t / analytical
                 measured += 1
+                # measured backward: time fwd+bwd together (what training
+                # compiles) and store the bwd/fwd ratio, replacing the
+                # flat 2x heuristic (reference: simulator.cc:537)
+                try:
+                    tg = self.measure_operator_cost(
+                        node, in_shapes, compute_dtype=compute_dtype,
+                        direction="grad")
+                except Exception:
+                    continue  # not differentiable standalone — keep 2x
+                if tg > t:
+                    # clamp to the physically plausible band (bwd recomputes
+                    # ~2 forward-sized passes plus extra HBM traffic) so a
+                    # noisy micro-measurement cannot distort the ranking
+                    self._key_bwd_ratio[key] = min(
+                        max((tg - t) / t, 0.25), 4.0)
         return measured
 
     def measure_operator_cost(self, node: PCGNode,
                               in_shapes: List[Tuple[int, ...]],
                               iters: Optional[int] = None,
-                              compute_dtype=None) -> float:
+                              compute_dtype=None,
+                              direction: str = "fwd") -> float:
         """Time one op standalone on the current backend, cached by params key
-        (reference: measure_operator_cost, simulator.cc:489 — cudaEvents).
+        (reference: measure_operator_cost, simulator.cc:489 — cudaEvents;
+        ``direction="grad"`` mirrors inner_measure_operator_cost running both
+        directions, model.cu:38 — it times value_and_grad, i.e. fwd+bwd
+        together, the shape XLA actually compiles in training).
 
         All ``iters`` applications run inside ONE jitted ``lax.scan`` whose
         carry chains each iteration's inputs to the previous output's
@@ -455,7 +508,7 @@ class Simulator:
         ``iters`` is sized from the analytical estimate to push total device
         time well past the round trip, which is separately measured with an
         identity jit and subtracted."""
-        key = self._op_key(node, in_shapes) + (str(compute_dtype),)
+        key = self._op_key(node, in_shapes) + (str(compute_dtype), direction)
         if key in self._measure_cache:
             return self._measure_cache[key]
         import time
@@ -478,6 +531,10 @@ class Simulator:
                 w = w.astype(compute_dtype)
             params[wname] = w
         ctx = OpContext(training=False)
+        float_ix = [i for i, x in enumerate(xs)
+                    if jnp.issubdtype(x.dtype, jnp.floating)]
+        if direction == "grad" and not params and not float_ix:
+            raise ValueError(f"{op.name}: nothing differentiable to time")
 
         def make_f(n_iters):
             @jax.jit
@@ -495,7 +552,44 @@ class Simulator:
                 (_, acc), _ = jax.lax.scan(body, (list(xs), jnp.zeros(())),
                                            None, length=n_iters)
                 return acc
-            return f
+
+            if direction != "grad":
+                return f
+
+            @jax.jit
+            def g(params, xs):
+                def body(carry, _):
+                    cur, acc = carry
+
+                    def loss(p, fl):
+                        full = list(cur)
+                        for j, i in enumerate(float_ix):
+                            full[i] = fl[j]
+                        outs = op.forward(p, full, ctx)
+                        leaf = jax.tree_util.tree_leaves(outs)[0].astype(
+                            jnp.float32)
+                        return jnp.vdot(leaf, leaf)
+
+                    val, (gp, gx) = jax.value_and_grad(loss, argnums=(0, 1))(
+                        params, [cur[i] for i in float_ix])
+                    # fold EVERY grad leaf into the carry: an unused leaf
+                    # would let XLA dead-code-eliminate its slice of the
+                    # backward pass (e.g. the dgrad matmul) and under-count
+                    # the ratio
+                    gleaves = jax.tree_util.tree_leaves((gp, gx))
+                    gsum = val
+                    for gl in gleaves:
+                        glf = gl.astype(jnp.float32)
+                        gsum = gsum + jnp.vdot(glf, glf)
+                    s = gsum * 1e-30
+                    nxt = [x * (1.0 + s).astype(x.dtype) if jnp.issubdtype(
+                        x.dtype, jnp.floating) else x for x in cur]
+                    return (nxt, acc + s), ()
+
+                (_, acc), _ = jax.lax.scan(body, (list(xs), jnp.zeros(())),
+                                           None, length=n_iters)
+                return acc
+            return g
 
         def timed(fn, *args):
             out = fn(*args)  # compile + settle
@@ -527,6 +621,8 @@ class Simulator:
                 # analytical estimate (near-truth on the real chip)
                 est = self.op_cost(node, in_shapes,
                                    OpSharding()).forward_time
+                if direction == "grad":
+                    est *= 3.0
                 target = max(5.0 * overhead, 0.4)
                 iters = int(min(max(target / max(est, 1e-6), 16), 4096))
         total = timed(make_f(iters), params, xs)

@@ -370,7 +370,7 @@ def pipeline_microbatch_safe(pcg: PCG, batch: int) -> bool:
             tgt = tuple(n.op.attrs.get("shape", ()))
             in_shape = (pcg.nodes[n.inputs[0][0]].out_shapes[n.inputs[0][1]]
                         if n.inputs else ())
-            if tgt and in_shape and batch in in_shape:
+            if tgt and in_shape and in_shape[0] == batch:
                 # the input carries the batch: an all-explicit target bakes
                 # the global batch volume (ReshapeOp asserts on a
                 # microbatch), and a -1 wildcard anywhere but the leading
