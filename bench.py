@@ -143,7 +143,66 @@ def main():
     if on_tpu:
         result.update(cost_model_checks(ff, config, dt,
                                         example_batch=(xd, yd)))
+        result.update(dropout_mfu_leg(cfg, flops_per_step, peak))
     print(json.dumps(result))
+
+
+def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
+    """Real-pretraining shape: attention dropout 0.1 stays ON the in-kernel
+    flash path (VERDICT r3 item 3 Done criterion: >= 0.5 MFU with dropout;
+    previously the op silently fell back to the einsum core)."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.random as jrandom
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, \
+        LossType
+    from flexflow_tpu.models.bert import build_bert
+
+    out = {}
+    try:
+        cfg2 = dataclasses.replace(cfg, dropout=0.1)
+        config = FFConfig()
+        config.batch_size = cfg2.batch_size
+        config.compute_dtype = DataType.DT_BFLOAT16
+        ff = FFModel(config)
+        build_bert(ff, cfg2)
+        ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        step = ff.executor.make_train_step()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(cfg2.batch_size, cfg2.seq_len, cfg2.hidden)
+                       ).astype(np.float32)
+        y = rng.integers(0, cfg2.num_classes,
+                         size=(cfg2.batch_size, 1)).astype(np.int32)
+        xd = [jax.device_put(x, ff.executor.batch_sharding(3))]
+        yd = jax.device_put(y, ff.executor.batch_sharding(2))
+        params, opt_state = ff.params, ff.opt_state
+        for i in range(2):
+            params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                              jrandom.PRNGKey(i))
+        _ = float(loss)
+        # same median-of-3-windows recipe as the headline number (single
+        # windows swing ~8% on the tunneled chip)
+        iters = 6
+        windows = []
+        for w in range(3):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                params, opt_state, loss, _ = step(
+                    params, opt_state, xd, yd,
+                    jrandom.PRNGKey(50 + w * iters + i))
+            _ = float(loss)
+            windows.append((time.perf_counter() - t0) / iters)
+        dt = sorted(windows)[1]
+        out["mfu_dropout01"] = round(flops_per_step / dt / peak, 4)
+        out["step_ms_dropout01"] = round(dt * 1e3, 2)
+    except Exception as e:
+        out["dropout_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
 
 
 def cost_model_checks(ff, config, measured_step_s: float,

@@ -22,6 +22,68 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
+def dropout_keep_scale(seed, bh, q_start, k_start, block_q, block_k,
+                       rate: float):
+    """Counter-based dropout mask for one (block_q, block_k) score tile:
+    {0, 1/(1-rate)} as f32, a pure function of the GLOBAL (seed, batch*head,
+    q_pos, k_pos) coordinates — so the forward kernel and both backward
+    kernels regenerate the SAME mask regardless of block decomposition
+    (reference analog: cuDNN's dropout descriptor inside the fused MHA,
+    src/ops/attention.cu:225). One murmur3-finalizer round per element over
+    a linear counter; plain uint32 ops, so it runs identically compiled on
+    TPU and in interpret mode."""
+    import jax
+    import jax.numpy as jnp
+
+    qpos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return dropout_keep_scale_nd(seed, jnp.asarray(bh, jnp.uint32),
+                                 qpos, kpos, rate)
+
+
+def dropout_keep_scale_nd(seed, bh, q_pos, k_pos, rate: float):
+    """Vectorized twin of ``dropout_keep_scale`` for the non-Pallas paths
+    (ring/Ulysses sequence parallelism): ``bh``/``q_pos``/``k_pos`` are
+    broadcastable uint32 arrays of GLOBAL coordinates, so every chip of an
+    SP group draws decorrelated masks from the same counter stream."""
+    import jax.numpy as jnp
+
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         + bh.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+         + jnp.asarray(seed, jnp.uint32))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    threshold = jnp.uint32(min(int(rate * 2 ** 32), 2 ** 32 - 1))
+    return (x >= threshold).astype(jnp.float32) / (1.0 - rate)
+
+
+def coerce_dropout_seed(name: str, dropout: float, seed):
+    """Shared validation + uint32 coercion for every dropout entry point
+    (flash / ring / Ulysses) so the contract cannot drift."""
+    import jax.numpy as jnp
+
+    if dropout > 0.0 and seed is None:
+        raise ValueError(f"{name} dropout requires a seed")
+    return jnp.asarray(seed if seed is not None else 0, jnp.uint32)
+
+
+def global_bh_indices(b_local: int, total_heads: int, h_local: int,
+                      b_base, h_base):
+    """(b_local, h_local) uint32 grid of GLOBAL batch*head indices for the
+    dropout counter stream — one implementation shared by ring and Ulysses
+    so their masks stay on the same stream as the flash kernel's."""
+    import jax.numpy as jnp
+
+    return ((b_base + jnp.arange(b_local))[:, None] * total_heads
+            + h_base + jnp.arange(h_local)[None, :]).astype(jnp.uint32)
+
+
 def _apply_causal_mask(s, q_start, k_start, offset, block_q, block_k):
     """Causal mask for one (block_q, block_k) score tile. ``offset`` aligns
     rectangular shapes the same way the einsum core's ``tril(k=sk-sq)`` does:
@@ -44,15 +106,17 @@ def _causal_num_kb(q_idx, block_q, block_k, num_kb, offset):
     return jnp.clip(last, 0, num_kb)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                      seq_k: int, causal: bool, sm_scale: float,
-                      causal_offset: int = 0):
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k: int, seq_k: int, causal: bool,
+                      sm_scale: float, causal_offset: int = 0,
+                      dropout: float = 0.0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     q = q_ref[...]  # (block_q, d) — kept in input dtype: bf16 feeds the MXU
     block_q = q.shape[0]
+    bh = pl.program_id(0)
     q_idx = pl.program_id(1)
 
     m = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -73,9 +137,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
+        # softmax normalizer from UNDROPPED p: dropout applies to the
+        # normalized probabilities, and elementwise mask/scale commutes
+        # with the 1/l normalization
         l_new = l * alpha + jnp.sum(p, axis=-1)
+        if dropout > 0.0:
+            p_acc = p * dropout_keep_scale(seed_ref[0], bh,
+                                           q_idx * block_q, kb * block_k,
+                                           block_q, block_k, dropout)
+        else:
+            p_acc = p
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p_acc.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     if causal:
@@ -94,7 +167,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, dropout: float = 0.0, seed=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -108,15 +181,18 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     qr = q.reshape(batch * heads, seq_q, d)
     kr = k.reshape(batch * heads, seq_k, d)
     vr = v.reshape(batch * heads, seq_k, d)
+    seed_arr = jnp.reshape(jnp.asarray(
+        seed if seed is not None else 0, jnp.uint32), (1,))
 
     grid = (batch * heads, seq_q // block_q)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                seq_k=seq_k, causal=causal, sm_scale=sm_scale,
-                               causal_offset=seq_k - seq_q)
+                               causal_offset=seq_k - seq_q, dropout=dropout)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1,), lambda b, i: (0,)),
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
@@ -130,17 +206,22 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             jax.ShapeDtypeStruct((batch * heads, seq_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(seed_arr, qr, kr, vr)
     return (out.reshape(batch, heads, seq_q, d),
             lse.reshape(batch, heads, seq_q))
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
-                          causal: bool, sm_scale: float,
-                          causal_offset: int = 0):
+def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, *, block_q: int,
+                          seq_q: int, causal: bool, sm_scale: float,
+                          causal_offset: int = 0, dropout: float = 0.0):
     """Grid (batch*heads, seq_k//block_k): one (dk, dv) tile per k block,
-    streaming q/do/lse/delta blocks — the FlashAttention-2 backward split."""
+    streaming q/do/lse/delta blocks — the FlashAttention-2 backward split.
+
+    With dropout (mask D regenerated from the same counters as forward):
+    dV = (P∘D)ᵀ dO and dS = P ∘ (D∘dP - δ) — δ = rowsum(dO∘O) already
+    equals rowsum(P∘D ∘ dP), so the softmax-backward identity holds with
+    the dropped probabilities folded in."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -149,6 +230,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[...]
     block_k = k.shape[0]
     d = k.shape[1]
+    bh = pl.program_id(0)
     kb = pl.program_id(1)
 
     dk = jnp.zeros((block_k, d), jnp.float32)
@@ -166,9 +248,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _apply_causal_mask(s, qb * block_q, kb * block_k,
                                    causal_offset, block_q, block_k)
         p = jnp.exp(s - lse)  # exact softmax probabilities from stored lse
-        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
-                          preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            keep = dropout_keep_scale(seed_ref[0], bh, qb * block_q,
+                                      kb * block_k, block_q, block_k,
+                                      dropout)
+            pd = p * keep
+            dp = dp * keep
+        else:
+            pd = p
+        dv = dv + jnp.dot(pd.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
                           preferred_element_type=jnp.float32)
@@ -184,9 +274,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, seq_k: int, causal: bool,
-                         sm_scale: float, causal_offset: int = 0):
+def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, *, block_k: int, seq_k: int,
+                         causal: bool, sm_scale: float,
+                         causal_offset: int = 0, dropout: float = 0.0):
     """Grid (batch*heads, seq_q//block_q): one dq tile per q block."""
     import jax
     import jax.numpy as jnp
@@ -198,6 +289,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[...]
     block_q = q.shape[0]
     d = q.shape[1]
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
 
     dq = jnp.zeros((block_q, d), jnp.float32)
@@ -212,6 +304,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                    causal_offset, block_q, block_k)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp = dp * dropout_keep_scale(seed_ref[0], bh, qb * block_q,
+                                         kb * block_k, block_q, block_k,
+                                         dropout)
         ds = p * (dp - delta) * sm_scale
         return dq + jnp.dot(ds.astype(k.dtype), k,
                             preferred_element_type=jnp.float32)
@@ -226,7 +322,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, dropout: float = 0.0,
+                    seed=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -244,7 +341,10 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
     lser = lse.reshape(batch * heads, seq_q, 1)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(batch * heads, seq_q, 1)
+    seed_arr = jnp.reshape(jnp.asarray(
+        seed if seed is not None else 0, jnp.uint32), (1,))
 
+    seed_spec = pl.BlockSpec((1,), lambda b, i: (0,))
     full_q = pl.BlockSpec((None, seq_q, d), lambda b, i: (b, 0, 0))
     full_q1 = pl.BlockSpec((None, seq_q, 1), lambda b, i: (b, 0, 0))
     full_k = pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0))
@@ -254,28 +354,30 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal,
-        sm_scale=sm_scale, causal_offset=seq_k - seq_q)
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(batch * heads, seq_k // block_k),
-        in_specs=[full_q, tile_k, tile_k, full_q, full_q1, full_q1],
+        in_specs=[seed_spec, full_q, tile_k, tile_k, full_q, full_q1,
+                  full_q1],
         out_specs=[tile_k, tile_k],
         out_shape=[jax.ShapeDtypeStruct((batch * heads, seq_k, d), k.dtype),
                    jax.ShapeDtypeStruct((batch * heads, seq_k, d), v.dtype)],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(seed_arr, qr, kr, vr, dor, lser, delta)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal,
-        sm_scale=sm_scale, causal_offset=seq_k - seq_q)
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(batch * heads, seq_q // block_q),
-        in_specs=[tile_q, full_k, full_k, tile_q, tile_q1, tile_q1],
+        in_specs=[seed_spec, tile_q, full_k, full_k, tile_q, tile_q1,
+                  tile_q1],
         out_specs=tile_q,
         out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(seed_arr, qr, kr, vr, dor, lser, delta)
 
     return (dq.reshape(batch, heads, seq_q, d),
             dk.reshape(batch, heads, seq_k, d),
@@ -298,22 +400,45 @@ def _reference_core(q, k, v, causal: bool):
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_p(q, k, v, seed, causal, block_q, block_k, interpret,
+                       dropout):
+    _check_causal_shape(q, k, causal)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
+                            _resolve_interpret(interpret),
+                            dropout=dropout, seed=seed)
+    return out
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    dropout: float = 0.0, seed=None):
     """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim).
 
     seq_q/seq_k must be multiples of the block sizes (the attention op checks
     this before selecting the flash path, ops/attention.py). Causal requires
     seq_q <= seq_k: with more queries than keys the leading queries attend an
     empty window, which only the einsum core's degenerate uniform-softmax
-    handles — use mha_core for that case."""
-    _check_causal_shape(q, k, causal)
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
-                            _resolve_interpret(interpret))
-    return out
+    handles — use mha_core for that case.
+
+    ``dropout``/``seed``: in-kernel attention-probability dropout via a
+    counter-based PRNG on global (batch*head, q_pos, k_pos) coordinates, so
+    forward and both backward kernels regenerate identical masks without
+    materializing them in HBM (the cuDNN-MHA dropout analog,
+    reference src/ops/attention.cu:225). ``seed`` is a traced uint32 scalar
+    — reseed per step without recompiling."""
+    import jax.numpy as jnp
+
+    dropout = float(dropout)
+    if not 0.0 <= dropout < 1.0:
+        raise ValueError(f"dropout must be in [0, 1), got {dropout}")
+    if dropout > 0.0 and seed is None:
+        raise ValueError("flash_attention dropout requires a seed")
+    seed = jnp.asarray(seed if seed is not None else 0, jnp.uint32)
+    return _flash_attention_p(q, k, v, seed, causal, block_q, block_k,
+                              interpret, dropout)
 
 
 def _check_causal_shape(q, k, causal: bool) -> None:
@@ -331,20 +456,24 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, seed, causal, block_q, block_k, interpret, dropout):
     _check_causal_shape(q, k, causal)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
-                              _resolve_interpret(interpret))
-    return out, (q, k, v, out, lse)
+                              _resolve_interpret(interpret),
+                              dropout=dropout, seed=seed)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, do):
+def _bwd(causal, block_q, block_k, interpret, dropout, res, do):
     """Backward by recompute (never materializes the score matrix): blockwise
     Pallas kernels using the flash-attention backward identities, with exact
-    probabilities reconstructed from the stored logsumexp."""
-    q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
-                           _resolve_interpret(interpret))
+    probabilities reconstructed from the stored logsumexp (and the dropout
+    mask regenerated from the same counters)."""
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, do, causal, block_q,
+                                 block_k, _resolve_interpret(interpret),
+                                 dropout=dropout, seed=seed)
+    return dq, dk, dv, None
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_attention_p.defvjp(_fwd, _bwd)

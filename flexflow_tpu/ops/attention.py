@@ -111,24 +111,29 @@ class MultiHeadAttentionOp(Op):
         causal = self.attrs.get("causal", False)
         seq_axis = self.attrs.get("sequence_parallel_axis")
         dropout = self.attrs.get("dropout", 0.0)
+        live_dropout = float(dropout) if (dropout and ctx.training
+                                          and ctx.rng is not None) else 0.0
+        seed = _dropout_seed(ctx.rng) if live_dropout else None
         if seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
             if self.attrs.get("sequence_parallel_mode") == "alltoall":
                 from ..kernels.ulysses_attention import ulysses_attention
 
                 out = ulysses_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
-                                        causal=causal)
+                                        causal=causal,
+                                        dropout=live_dropout, seed=seed)
             else:  # default schedule: ring rotation over ICI
                 from ..kernels.ring_attention import ring_attention
 
                 out = ring_attention(q, k, v, ctx.mesh, seq_axis=seq_axis,
-                                     causal=causal)
-        elif (dropout == 0.0 or not ctx.training) \
-                and _should_use_flash(use_flash, q, k, causal) \
+                                     causal=causal,
+                                     dropout=live_dropout, seed=seed)
+        elif _should_use_flash(use_flash, q, k, causal) \
                 and _flash_blocks(q.shape[-2], k.shape[-2]) is not None:
             from ..kernels.flash_attention import flash_attention
 
             bq, bk = _flash_blocks(q.shape[-2], k.shape[-2])
-            out = flash_attention(q, k, v, causal, bq, bk)
+            out = flash_attention(q, k, v, causal, bq, bk,
+                                  dropout=live_dropout, seed=seed)
         else:
             out = mha_core(q, k, v, causal=causal, dropout=dropout,
                            rng=ctx.rng, training=ctx.training)
@@ -154,6 +159,15 @@ class MultiHeadAttentionOp(Op):
             "heads": {"weights": {"wq": 1, "wk": 1, "wv": 1, "wo": 0},
                       "reduces_output": True},
         }
+
+
+def _dropout_seed(rng):
+    """Fold the step rng into one traced uint32 scalar for the counter-based
+    in-kernel dropout PRNG (reseeds every step without recompiling)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.bits(rng, (), jnp.uint32)
 
 
 def _flash_blocks(seq_q: int, seq_k: int):
@@ -218,18 +232,21 @@ class SDPAOp(Op):
         q, k, v = inputs[:3]
         mask = inputs[3] if len(inputs) > 3 else None
         causal = self.attrs.get("causal", False)
-        # flash kernel has no mask/scale/dropout parameters — only take it
-        # when the request needs none of them
+        # flash kernel has no mask/scale parameters — only take it when the
+        # request needs neither (dropout IS supported in-kernel)
         dropout = self.attrs.get("dropout", 0.0)
+        live_dropout = float(dropout) if (dropout and ctx.training
+                                          and ctx.rng is not None) else 0.0
         if mask is None and self.attrs.get("scale") is None \
-                and (dropout == 0.0 or not ctx.training) \
                 and _should_use_flash(
                     self.attrs.get("use_flash", "auto"), q, k, causal) \
                 and _flash_blocks(q.shape[-2], k.shape[-2]) is not None:
             from ..kernels.flash_attention import flash_attention
 
             bq, bk = _flash_blocks(q.shape[-2], k.shape[-2])
-            return [flash_attention(q, k, v, causal, bq, bk)]
+            seed = _dropout_seed(ctx.rng) if live_dropout else None
+            return [flash_attention(q, k, v, causal, bq, bk,
+                                    dropout=live_dropout, seed=seed)]
         return [mha_core(q, k, v, causal=causal,
                          dropout=self.attrs.get("dropout", 0.0),
                          rng=ctx.rng, training=ctx.training,

@@ -406,71 +406,168 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     """(step time, per-chip memory) for a GPipe (pp, dp) grid with
     ``n_micro`` microbatches.
 
-    Stage times come from the same per-op cost model as the SPMD search
-    (flops-balanced contiguous stages, parallel.pipeline.split_stages); the
-    schedule serializes on the slowest stage: T = Σ_s t_s + (m-1)·max_s t_s
-    (the GPipe bubble) + boundary activation hops + per-stage weight-grad
-    allreduce over dp. Microbatch stage time scales linearly from the
-    full-batch op costs. Memory = the heaviest stage's weights (replicated
-    over its dp group) + one microbatch of live activations (the trainer
-    rematerializes the stage forward in backward)."""
-    from ..parallel.pipeline import split_stages
+    The GPipe schedule is built as a TASK GRAPH and run through the SAME
+    event-driven native engine that costs SPMD candidates (reference prices
+    every strategy through simulate_runtime, simulator.cc:815 — one cost
+    engine, unbiased decision boundary): per-(microbatch, stage) forward and
+    remat+backward tasks on per-stage compute devices, boundary activation/
+    gradient hops on per-link devices, weight-grad allreduce + optimizer
+    update after each stage's flush. The bubble emerges from the schedule
+    instead of a closed form. Falls back to the additive closed form only
+    when the native core is unavailable.
+
+    Multi-host layout: stages are laid out contiguously over the machine's
+    chips, so stage s's dp group occupies chips [s*dp, (s+1)*dp) — each
+    stage's host span (DCN factor of its gradient sync) and each boundary's
+    medium (ICI within a host, DCN across) come from those cumulative chip
+    positions, covering pp < hosts and hosts∤pp alike.
+
+    Memory = the heaviest stage's weights + grads (replicated over its dp
+    group) + one microbatch of live activations (the trainer rematerializes
+    the stage forward inside backward)."""
+    from ..ffconst import size_of_datatype
+    from ..parallel.pipeline import build_stage_specs, split_stages
 
     stages = split_stages(pcg, pp)
-    stage_of = {g: s for s, guids in enumerate(stages) for g in guids}
-    sh = OpSharding(dp=dp)
-    # multi-host layout: stages are laid out contiguously over hosts. With
-    # pp >= hosts the dp groups stay within a host (sync on ICI) and
-    # hosts-1 stage boundaries cross DCN; with pp < hosts each stage spans
-    # hosts/pp hosts, so its dp gradient sync carries that DCN factor.
-    hosts = sim.machine.num_hosts
-    stage_dcn = max(hosts // pp, 1) if hosts > 1 else 1
-    if stage_dcn > 1 and dp % stage_dcn == 0:
-        sim.set_axis_topology(dp_dcn=stage_dcn)
-    stage_t = [0.0] * pp
+    machine = sim.machine
+    hosts = machine.num_hosts
+    cph = machine.chips_per_host
+
+    def first_host(s: int) -> int:
+        return (s * dp) // cph
+
+    def stage_host_span(s: int) -> int:
+        return ((s + 1) * dp - 1) // cph - first_host(s) + 1
+
+    # per-stage op costs, each priced at that stage's own host span
+    saved_topo = (sim.dp_dcn, sim.tp_dcn)
+    stage_fwd = [0.0] * pp
+    stage_bwd = [0.0] * pp  # includes the forward remat
     stage_sync = [0.0] * pp
+    stage_upd = [0.0] * pp
     stage_w = [0] * pp
     stage_act = [0] * pp
-    for node in pcg.compute_nodes():
-        in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
-        c = sim.op_cost(node, in_shapes, sh)
-        s = stage_of[node.guid]
-        # 2x forward: PipelineTrainer rematerializes the stage forward
-        # inside backward (the GPipe + full-remat recipe)
-        stage_t[s] += 2 * c.forward_time + c.backward_time
-        # each stage allreduces ITS weights over its own dp group; groups
-        # are disjoint chip sets, so stages sync concurrently
-        stage_sync[s] += c.sync_time
-        stage_w[s] += c.weights_memory
-        stage_act[s] += c.inputs_memory + c.outputs_memory
-    sync = max(stage_sync)
-    micro = [t / max(n_micro, 1) for t in stage_t]
-    bubble_time = sum(micro) + (n_micro - 1) * max(micro)
-    # boundary activations hop between stage submeshes once per microbatch
-    # per direction — price the SAME boundary set the trainer transfers
-    # (build_stage_specs exposes every cross-stage tensor, residual skips
-    # included), at the op's true element size
-    from ..ffconst import size_of_datatype
-    from ..parallel.pipeline import build_stage_specs
+    for s in range(pp):
+        span = stage_host_span(s) if hosts > 1 else 1
+        sim.set_axis_topology(
+            dp_dcn=span if (span > 1 and dp % span == 0) else 1)
+        for g in stages[s]:
+            node = pcg.nodes[g]
+            in_shapes = [pcg.nodes[gg].out_shapes[i]
+                         for gg, i in node.inputs]
+            c = sim.op_cost(node, in_shapes, OpSharding(dp=dp))
+            stage_fwd[s] += c.forward_time
+            stage_bwd[s] += c.forward_time + c.backward_time
+            stage_sync[s] += c.sync_time
+            stage_upd[s] += c.update_time
+            stage_w[s] += c.weights_memory
+            stage_act[s] += c.inputs_memory + c.outputs_memory
+    sim.set_axis_topology(*saved_topo)
 
+    # per-microbatch boundary hop time (the SAME boundary set the trainer
+    # transfers — build_stage_specs exposes every cross-stage tensor,
+    # residual skips included)
     specs = build_stage_specs(pcg, stages)
-    comm = 0.0
-    stages_per_host = max(pp // hosts, 1)
+    bnd_micro = [0.0] * max(pp - 1, 0)
     for s in range(pp - 1):
-        # boundary s->s+1 crosses DCN when the next stage starts a new host
-        crosses = hosts > 1 and pp >= hosts and \
-            (s + 1) % stages_per_host == 0
-        el_bw = sim.machine.dcn_bandwidth if crosses \
-            else sim.machine.ici_bandwidth
+        medium = "dcn" if (hosts > 1 and
+                           first_host(s) != first_host(s + 1)) else "ici"
         for g, i in specs[s].outputs:
             node = pcg.nodes[g]
             nbytes = int(np.prod(node.out_shapes[i])) * \
-                size_of_datatype(node.op.data_type)
-            comm += 2 * (nbytes / max(dp, 1)) / el_bw  # fwd + bwd hops
-    sim.set_axis_topology(1, 1)
-    mem = max(2 * w + act // max(n_micro, 1)  # weights + grads + micro acts
+                size_of_datatype(node.op.data_type) \
+                // (max(dp, 1) * max(n_micro, 1))
+            bnd_micro[s] += machine.p2p_time(nbytes, medium)
+
+    m_f = [t / max(n_micro, 1) for t in stage_fwd]
+    m_b = [t / max(n_micro, 1) for t in stage_bwd]
+    mem = max(2 * w + act // max(n_micro, 1)
               for w, act in zip(stage_w, stage_act))
-    return bubble_time + comm + sync, mem
+
+    try:
+        t = _pipeline_taskgraph_makespan(pp, n_micro, m_f, m_b, bnd_micro,
+                                         stage_sync, stage_upd)
+    except (ImportError, OSError) as e:
+        _warn_once("native-pipe-sim", "native core unavailable for the "
+                   "pipeline candidate (%s); using the additive bound", e)
+        micro = [f + b for f, b in zip(m_f, m_b)]
+        t = (sum(micro) + (n_micro - 1) * max(micro)
+             + 2 * n_micro * sum(bnd_micro)
+             + max(s + u for s, u in zip(stage_sync, stage_upd)))
+    return t, mem
+
+
+def _pipeline_taskgraph_makespan(pp: int, n_micro: int,
+                                 m_f: List[float], m_b: List[float],
+                                 bnd_micro: List[float],
+                                 stage_sync: List[float],
+                                 stage_upd: List[float]) -> float:
+    """Event-driven makespan of the GPipe schedule. Devices: [0, pp) stage
+    compute streams, [pp, 2pp-1) boundary links, [2pp-1, 3pp-1) per-stage
+    collective streams (disjoint chip groups sync concurrently)."""
+    from ..native import simulate_taskgraph
+
+    costs: List[float] = []
+    devs: List[int] = []
+    esrc: List[int] = []
+    edst: List[int] = []
+
+    def add(cost: float, dev: int) -> int:
+        costs.append(cost)
+        devs.append(dev)
+        return len(costs) - 1
+
+    def edge(a: int, b: int) -> None:
+        esrc.append(a)
+        edst.append(b)
+
+    link = lambda s: pp + s           # noqa: E731
+    coll = lambda s: 2 * pp - 1 + s   # noqa: E731
+
+    fwd_id: Dict[Tuple[int, int], int] = {}
+    for m in range(n_micro):
+        prev = None
+        for s in range(pp):
+            f = add(m_f[s], s)
+            if prev is not None:
+                edge(prev, f)
+            fwd_id[(m, s)] = f
+            if s < pp - 1:
+                c = add(bnd_micro[s], link(s))
+                edge(f, c)
+                prev = c
+            else:
+                prev = f
+    last_bwd: List[Optional[int]] = [None] * pp
+    for m in reversed(range(n_micro)):  # flush: last microbatch first
+        prev = None
+        for s in reversed(range(pp)):
+            b = add(m_b[s], s)
+            edge(fwd_id[(m, s)], b)  # remat consumes the stored stage input
+            if prev is not None:
+                edge(prev, b)
+            last_bwd[s] = b
+            if s > 0:
+                c = add(bnd_micro[s - 1], link(s - 1))
+                edge(b, c)
+                prev = c
+            else:
+                prev = b
+    for s in range(pp):
+        tail = last_bwd[s]
+        if tail is None:
+            continue
+        if stage_sync[s] > 0:
+            sy = add(stage_sync[s], coll(s))
+            edge(tail, sy)
+            tail = sy
+        if stage_upd[s] > 0:
+            up = add(stage_upd[s], s)
+            edge(tail, up)
+    return simulate_taskgraph(
+        np.asarray(costs), np.asarray(devs), 3 * pp - 1,
+        np.asarray(esrc, dtype=np.int32),
+        np.asarray(edst, dtype=np.int32))
 
 
 # ------------------------------------------------------------------ strategies

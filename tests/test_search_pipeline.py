@@ -170,3 +170,35 @@ def test_pipeline_skips_batch_baked_graphs():
 
     ff2, _ = _mlp(1001)
     assert pipeline_microbatch_safe(ff2.create_pcg(), 8)
+
+
+def test_pipeline_multihost_prices_dcn_boundaries():
+    """VERDICT r3 item 4 Done criterion: pipeline x multi-host. Stage chip
+    ranges come from cumulative positions — on a 2-host x 4-chip machine
+    with (pp=4, dp=2), only the stage-1->2 boundary crosses DCN; the same
+    grid on one host pays ICI everywhere and must be strictly cheaper."""
+    ff, _ = _mlp(512)
+    pcg = ff.create_pcg()
+    m1 = TPUMachineModel.from_generation("v5e", 8)
+    m2 = TPUMachineModel.from_generation("v5e", 8, num_hosts=2)
+    t1, _ = simulate_pipeline(Simulator(m1), pcg, pp=4, dp=2, n_micro=4)
+    t2, _ = simulate_pipeline(Simulator(m2), pcg, pp=4, dp=2, n_micro=4)
+    assert t2 > t1, (t2, t1)
+
+    # pp < hosts: every stage's dp group spans hosts, so the gradient sync
+    # itself rides DCN — dearer still than the boundary-only case
+    m4 = TPUMachineModel.from_generation("v5e", 8, num_hosts=4)
+    t4, _ = simulate_pipeline(Simulator(m4), pcg, pp=2, dp=4, n_micro=4)
+    t4_ici, _ = simulate_pipeline(Simulator(m1), pcg, pp=2, dp=4, n_micro=4)
+    assert t4 > t4_ici, (t4, t4_ici)
+
+
+def test_pipeline_topology_save_restore():
+    """simulate_pipeline must restore the caller's axis topology, not blind-
+    reset it to (1,1) (VERDICT r3 weak #9)."""
+    ff, _ = _mlp(256)
+    pcg = ff.create_pcg()
+    sim = Simulator(TPUMachineModel.from_generation("v5e", 8, num_hosts=2))
+    sim.set_axis_topology(dp_dcn=2, tp_dcn=1)
+    simulate_pipeline(sim, pcg, pp=2, dp=4, n_micro=2)
+    assert (sim.dp_dcn, sim.tp_dcn) == (2, 1)
