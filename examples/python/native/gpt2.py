@@ -1,0 +1,39 @@
+"""GPT-2-style causal LM (decoder-only; reference analog: the HF-traced
+decoder family of python/flexflow/torch/model.py:2427). Next-token training
+on random token streams; the causal attention core lowers to the Pallas
+flash kernel on TPU (flash-causal). Pass --compute-dtype bf16 for the
+mixed-precision path."""
+import numpy as np
+
+import _common  # noqa: F401
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+
+
+def main(argv=None, cfg=None):
+    config = FFConfig()
+    if argv:
+        config.parse_args(argv)
+    config.profiling = True
+    cfg = cfg or GPT2Config.tiny(batch_size=config.batch_size)
+    config.batch_size = cfg.batch_size
+    ff = FFModel(config)
+    ids, logits = build_gpt2(ff, cfg)
+    probs = ff.softmax(logits)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               final_tensor=probs)
+
+    n = cfg.batch_size * 2
+    rng = np.random.default_rng(0)
+    stream = rng.integers(0, cfg.vocab_size, size=(n, cfg.seq_len + 1))
+    x = stream[:, :-1].astype(np.int32)
+    y = stream[:, 1:].astype(np.int32)  # next-token targets
+    perf = ff.fit(x, y)
+    return ff, perf
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -34,8 +34,12 @@ def loss_value(loss_type: LossType, logits, labels, repl_labels: bool = False):
     if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
         # logits here are post-softmax probabilities (the reference applies
         # softmax as a graph op and the loss consumes probs, loss_functions.cu)
-        labels = labels.reshape(labels.shape[0])
-        logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+        # Token-level targets (causal LM: (b, s, vocab) probs vs (b, s)
+        # labels) flatten to one class axis — same math as the (b, vocab)
+        # classification case.
+        labels = labels.reshape(-1)
+        logp = jnp.log(jnp.clip(
+            logits.reshape(-1, logits.shape[-1]), 1e-12, 1.0))
         nll = -jnp.take_along_axis(
             logp, labels.astype(jnp.int32)[:, None], axis=-1)
         return jnp.mean(nll)

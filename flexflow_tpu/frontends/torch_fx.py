@@ -18,6 +18,75 @@ from ..model import FFModel
 from ..tensor import Tensor
 
 
+def _hf_trace_compat():
+    """Context manager unblocking decoder-only HF fx tracing (reference
+    traces the HF family generally, python/flexflow/torch/model.py:2427;
+    upstream transformers >= 4.5x breaks it in two places):
+
+    1. ``masking_utils._vmap_for_bhqkv`` builds attention masks through
+       ``torch.vmap``, which cannot map over HFProxy inputs. Swapped for a
+       broadcasting equivalent — every stock mask_function is elementwise
+       arithmetic / advanced indexing, so reshaping the index vectors to
+       (b,1,1,1)/(1,h,1,1)/(1,1,q,1)/(1,1,1,kv) computes the identical
+       mask.
+    2. ``(*states.shape[:-1], -1, head_dim)`` unpacks a shape proxy, which
+       ``Tracer.iter`` rejects. When the proxy's installed metadata is a
+       concrete ``torch.Size``, iterate SYMBOLIC ``obj[i]`` getitems (not
+       the metadata values — those are the tracer's dummy dims and must
+       not be baked into the graph).
+
+    Both patches are restored on exit; eager execution is untouched.
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        import torch
+
+        try:
+            from transformers import masking_utils
+            from transformers.utils import fx as hf_fx
+        except ImportError:
+            yield
+            return
+
+        def broadcast_for_bhqkv(mask_function, bh_indices=True):
+            def fn(batch_idx, head_idx, q_idx, kv_idx):
+                if bh_indices:
+                    q = q_idx.reshape(1, 1, -1, 1)
+                    kv = kv_idx.reshape(1, 1, 1, -1)
+                    if batch_idx is not None:
+                        batch_idx = batch_idx.reshape(-1, 1, 1, 1)
+                    if head_idx is not None:
+                        head_idx = head_idx.reshape(1, -1, 1, 1)
+                else:
+                    q = q_idx.reshape(-1, 1)
+                    kv = kv_idx.reshape(1, -1)
+                return mask_function(batch_idx, head_idx, q, kv)
+            return fn
+
+        orig_vmap = getattr(masking_utils, "_vmap_for_bhqkv", None)
+        orig_iter = hf_fx.HFTracer.iter
+
+        def iter_with_meta(self, obj):
+            meta = getattr(obj, "_metadata", None)
+            if isinstance(meta, (torch.Size, tuple)):
+                return iter([obj[i] for i in range(len(meta))])
+            return orig_iter(self, obj)
+
+        if orig_vmap is not None:
+            masking_utils._vmap_for_bhqkv = broadcast_for_bhqkv
+        hf_fx.HFTracer.iter = iter_with_meta
+        try:
+            yield
+        finally:
+            if orig_vmap is not None:
+                masking_utils._vmap_for_bhqkv = orig_vmap
+            hf_fx.HFTracer.iter = orig_iter
+
+    return cm()
+
+
 class PyTorchModel:
     """reference: python/flexflow/torch/model.py:2408."""
 
@@ -41,8 +110,9 @@ class PyTorchModel:
         if self.is_hf_model:
             from transformers.utils.fx import symbolic_trace as hf_trace
 
-            traced = hf_trace(self.module,
-                              input_names=input_names or ["input_ids"])
+            with _hf_trace_compat():
+                traced = hf_trace(self.module,
+                                  input_names=input_names or ["input_ids"])
         else:
             traced = fx.symbolic_trace(self.module)
 
@@ -559,6 +629,33 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
                 return np.squeeze(x)
             if t in ("contiguous", "clone", "detach"):
                 return x
+            if t == "cumsum":
+                dim = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+                return np.cumsum(x, axis=int(dim))
+            if t == "ne":
+                return x != np.asarray(args[1])
+            if t == "eq":
+                return x == np.asarray(args[1])
+            if t == "flatten":
+                start = int(kwargs.get("start_dim",
+                                       args[1] if len(args) > 1 else 0))
+                end = int(kwargs.get("end_dim",
+                                     args[2] if len(args) > 2 else -1))
+                end = end % x.ndim
+                sh = list(x.shape)
+                new = sh[:start] + \
+                    [int(np.prod(sh[start:end + 1]))] + sh[end + 1:]
+                return x.reshape(new)
+            if t in ("new_ones", "new_zeros", "new_full"):
+                shape = args[1] if isinstance(args[1], (tuple, list)) \
+                    else args[1:] if t != "new_full" else args[1]
+                shape = [int(s) for s in shape]
+                dt = kwargs.get("dtype")
+                np_dt = _np_dtype(dt) if dt is not None else x.dtype
+                if t == "new_full":
+                    return np.full(shape, args[2], dtype=np_dt)
+                fill = np.ones if t == "new_ones" else np.zeros
+                return fill(shape, dtype=np_dt)
             raise NotImplementedError(f"torch method {t} on host value")
         # ---- graph ops on Tensors -----------------------------------------
         if t == "view" or t == "reshape":
@@ -731,11 +828,43 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return np.full(shape, fill, dtype=np_dt)
     if t is torch.zeros_like and not _is_ff(args[0]):
         return np.zeros_like(np.asarray(args[0]))
+    if t is getattr(torch, "diff", None) and not _is_ff(args[0]):
+        # packed-sequence detection in masking_utils runs on host indices
+        extra = {}
+        for kw in ("prepend", "append"):
+            if kwargs.get(kw) is not None:
+                extra[kw] = np.asarray(kwargs[kw])
+        n = int(kwargs.get("n", args[1] if len(args) > 1 else 1))
+        return np.diff(np.asarray(args[0]), n=n,
+                       axis=kwargs.get("dim", args[2] if len(args) > 2
+                                       else -1), **extra)
     if t is torch.ones_like and not _is_ff(args[0]):
         return np.ones_like(np.asarray(args[0]))
+    if t in (operator.and_, operator.or_) and not _is_ff(args[0]) \
+            and not _is_ff(args[1]):
+        # boolean mask combination (masking_utils.and_masks/or_masks)
+        op_np = np.logical_and if t is operator.and_ else np.logical_or
+        return op_np(np.asarray(args[0]), np.asarray(args[1]))
+    if t in (operator.invert, torch.logical_not) and not _is_ff(args[0]):
+        return np.logical_not(np.asarray(args[0]))
+    if t in (torch.all, torch.any) and not _is_ff(args[0]):
+        red = np.all if t is torch.all else np.any
+        dim = kwargs.get("dim", args[1] if len(args) > 1 else None)
+        return red(np.asarray(args[0])) if dim is None else \
+            red(np.asarray(args[0]), axis=int(dim))
     if t is torch.where and not any(_is_ff(a) for a in args[:3]):
         return np.where(np.asarray(args[0]), np.asarray(args[1]),
                         np.asarray(args[2]))
+    if t is torch.where and not _is_ff(args[0]) and _is_ff(args[1]):
+        # graph select with a host condition (gpt-neo causal masking:
+        # where(mask, scores, finfo.min)) — lower to mask arithmetic
+        m = np.asarray(args[0]).astype(np.float32)
+        left = ffmodel.multiply(args[1], _as_ff(ffmodel, m))
+        if _is_ff(args[2]):
+            return ffmodel.add(left, ffmodel.multiply(
+                args[2], _as_ff(ffmodel, 1.0 - m)))
+        other = np.asarray(args[2], dtype=np.float32) * (1.0 - m)
+        return ffmodel.add(left, _as_ff(ffmodel, other))
     if t is torch.triu and not _is_ff(args[0]):
         return np.triu(np.asarray(args[0]), k=kwargs.get(
             "diagonal", args[1] if len(args) > 1 else 0))
@@ -764,7 +893,21 @@ def _convert_function(ffmodel: FFModel, node, args, kwargs):
         return ffmodel.sdpa(q, k, v, attn_mask=mask, dropout=dropout_p,
                             causal=is_causal, scale=kwargs.get("scale"))
 
+    if t is torch.addmm and _is_ff(args[1]) and not _is_ff(args[0]) \
+            and not _is_ff(args[2]):
+        # HF Conv1D (gpt2): addmm(bias, x_2d, weight) with weight (in, out)
+        # — a dense layer whose kernel is already in our layout
+        w = np.asarray(args[2])
+        out = ffmodel.dense(args[1], w.shape[1], use_bias=True,
+                            name=node.name)
+        _set_weight(ffmodel, out, {"kernel": w,
+                                   "bias": np.asarray(args[0])})
+        return out
     if t in (operator.add, torch.add):
+        if isinstance(args[0], (tuple, list)) and \
+                isinstance(args[1], (tuple, list)):
+            # shape arithmetic: size()[:-1] + (nf,) concatenates
+            return tuple(args[0]) + tuple(args[1])
         return _binary(ffmodel, "add", args)
     if t in (operator.sub, torch.sub):
         return _binary(ffmodel, "subtract", args)

@@ -68,6 +68,8 @@ def coerce_dropout_seed(name: str, dropout: float, seed):
     (flash / ring / Ulysses) so the contract cannot drift."""
     import jax.numpy as jnp
 
+    if not 0.0 <= float(dropout) < 1.0:
+        raise ValueError(f"{name} dropout must be in [0, 1), got {dropout}")
     if dropout > 0.0 and seed is None:
         raise ValueError(f"{name} dropout requires a seed")
     return jnp.asarray(seed if seed is not None else 0, jnp.uint32)
@@ -429,14 +431,8 @@ def flash_attention(q, k, v, causal: bool = False,
     materializing them in HBM (the cuDNN-MHA dropout analog,
     reference src/ops/attention.cu:225). ``seed`` is a traced uint32 scalar
     — reseed per step without recompiling."""
-    import jax.numpy as jnp
-
     dropout = float(dropout)
-    if not 0.0 <= dropout < 1.0:
-        raise ValueError(f"dropout must be in [0, 1), got {dropout}")
-    if dropout > 0.0 and seed is None:
-        raise ValueError("flash_attention dropout requires a seed")
-    seed = jnp.asarray(seed if seed is not None else 0, jnp.uint32)
+    seed = coerce_dropout_seed("flash_attention", dropout, seed)
     return _flash_attention_p(q, k, v, seed, causal, block_q, block_k,
                               interpret, dropout)
 

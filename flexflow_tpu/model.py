@@ -721,6 +721,8 @@ class FFModel:
     def _prep_label(self, y: np.ndarray) -> np.ndarray:
         y = np.asarray(y)
         if self.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            if y.ndim >= 2 and int(np.prod(y.shape[1:])) > 1:
+                return y.astype(np.int32)  # token-level targets (causal LM)
             y = y.reshape(y.shape[0], 1).astype(np.int32)
         return y
 

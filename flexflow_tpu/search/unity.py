@@ -447,22 +447,24 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     stage_upd = [0.0] * pp
     stage_w = [0] * pp
     stage_act = [0] * pp
-    for s in range(pp):
-        span = stage_host_span(s) if hosts > 1 else 1
-        sim.set_axis_topology(
-            dp_dcn=span if (span > 1 and dp % span == 0) else 1)
-        for g in stages[s]:
-            node = pcg.nodes[g]
-            in_shapes = [pcg.nodes[gg].out_shapes[i]
-                         for gg, i in node.inputs]
-            c = sim.op_cost(node, in_shapes, OpSharding(dp=dp))
-            stage_fwd[s] += c.forward_time
-            stage_bwd[s] += c.forward_time + c.backward_time
-            stage_sync[s] += c.sync_time
-            stage_upd[s] += c.update_time
-            stage_w[s] += c.weights_memory
-            stage_act[s] += c.inputs_memory + c.outputs_memory
-    sim.set_axis_topology(*saved_topo)
+    try:
+        for s in range(pp):
+            span = stage_host_span(s) if hosts > 1 else 1
+            sim.set_axis_topology(
+                dp_dcn=span if (span > 1 and dp % span == 0) else 1)
+            for g in stages[s]:
+                node = pcg.nodes[g]
+                in_shapes = [pcg.nodes[gg].out_shapes[i]
+                             for gg, i in node.inputs]
+                c = sim.op_cost(node, in_shapes, OpSharding(dp=dp))
+                stage_fwd[s] += c.forward_time
+                stage_bwd[s] += c.forward_time + c.backward_time
+                stage_sync[s] += c.sync_time
+                stage_upd[s] += c.update_time
+                stage_w[s] += c.weights_memory
+                stage_act[s] += c.inputs_memory + c.outputs_memory
+    finally:
+        sim.set_axis_topology(*saved_topo)
 
     # per-microbatch boundary hop time (the SAME boundary set the trainer
     # transfers — build_stage_specs exposes every cross-stage tensor,
@@ -538,7 +540,7 @@ def _pipeline_taskgraph_makespan(pp: int, n_micro: int,
                 prev = c
             else:
                 prev = f
-    last_bwd: List[Optional[int]] = [None] * pp
+    bwd_ids: List[List[int]] = [[] for _ in range(pp)]
     for m in reversed(range(n_micro)):  # flush: last microbatch first
         prev = None
         for s in reversed(range(pp)):
@@ -546,7 +548,7 @@ def _pipeline_taskgraph_makespan(pp: int, n_micro: int,
             edge(fwd_id[(m, s)], b)  # remat consumes the stored stage input
             if prev is not None:
                 edge(prev, b)
-            last_bwd[s] = b
+            bwd_ids[s].append(b)
             if s > 0:
                 c = add(bnd_micro[s - 1], link(s - 1))
                 edge(b, c)
@@ -554,16 +556,23 @@ def _pipeline_taskgraph_makespan(pp: int, n_micro: int,
             else:
                 prev = b
     for s in range(pp):
-        tail = last_bwd[s]
-        if tail is None:
+        if not bwd_ids[s]:
             continue
+        tail = bwd_ids[s][-1]
         if stage_sync[s] > 0:
+            # grad allreduce waits for the stage's ENTIRE backward flush —
+            # every microbatch contributes to the weight grads
             sy = add(stage_sync[s], coll(s))
-            edge(tail, sy)
+            for b in bwd_ids[s]:
+                edge(b, sy)
             tail = sy
         if stage_upd[s] > 0:
             up = add(stage_upd[s], s)
-            edge(tail, up)
+            if tail == bwd_ids[s][-1]:  # no sync: update waits on all bwds
+                for b in bwd_ids[s]:
+                    edge(b, up)
+            else:
+                edge(tail, up)
     return simulate_taskgraph(
         np.asarray(costs), np.asarray(devs), 3 * pp - 1,
         np.asarray(esrc, dtype=np.int32),

@@ -226,3 +226,7 @@ def test_t5_mt5_example():
 def test_keras_net2net_weight_transfer():
     _, _ = _load("keras", "func_mnist_mlp_net2net").main(
         ["-b", "16", "-e", "1"], num_samples=64)
+
+
+def test_gpt2_example():
+    _, perf = _load("native", "gpt2").main(["-b", "4", "-e", "1"])

@@ -75,8 +75,9 @@ def test_keras_dataset_loaders_shapes():
     assert xm.shape == (60000, 28, 28) and xm.dtype == np.uint8
     assert ym.shape == (60000,)
     (xc, yc), _ = datasets.cifar10.load_data()
-    assert xc.shape == (50000, 3, 32, 32)
-    assert yc.shape == (50000, 1)
+    # reference default: load_data(num_samples=40000), cifar10.py:13
+    assert xc.shape == (40000, 3, 32, 32)
+    assert yc.shape == (40000, 1)
     (xr, yr), (xrt, yrt) = datasets.reuters.load_data(num_words=100)
     assert all(max(seq) < 100 for seq in xr[:50])
     tok = preprocessing.text.Tokenizer(num_words=100)
