@@ -78,7 +78,10 @@ def main():
     if on_tpu:
         cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
                          num_heads=16, num_layers=24, intermediate=4096)
-        warmup, iters = 3, 10
+        # 30 iters/window: the tunneled platform pays one ~75 ms RTT for
+        # the end-of-window loss readback — over 10 iters that inflated
+        # every step by ~7.5 ms (round-3 profile, BASELINE.md breakdown)
+        warmup, iters = 3, 30
     else:  # CI smoke path
         cfg = BertConfig.tiny(batch_size=8)
         warmup, iters = 1, 3
@@ -186,8 +189,9 @@ def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
                                               jrandom.PRNGKey(i))
         _ = float(loss)
         # same median-of-3-windows recipe as the headline number (single
-        # windows swing ~8% on the tunneled chip)
-        iters = 6
+        # windows swing ~8% on the tunneled chip; short windows also pay
+        # the ~75 ms readback RTT over too few steps)
+        iters = 20
         windows = []
         for w in range(3):
             t0 = time.perf_counter()

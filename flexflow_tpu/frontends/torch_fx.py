@@ -9,6 +9,7 @@ correctness tier, tests/align (SURVEY §4).
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -18,6 +19,7 @@ from ..model import FFModel
 from ..tensor import Tensor
 
 
+@contextlib.contextmanager
 def _hf_trace_compat():
     """Context manager unblocking decoder-only HF fx tracing (reference
     traces the HF family generally, python/flexflow/torch/model.py:2427;
@@ -37,54 +39,48 @@ def _hf_trace_compat():
 
     Both patches are restored on exit; eager execution is untouched.
     """
-    import contextlib
+    import torch
 
-    @contextlib.contextmanager
-    def cm():
-        import torch
+    try:
+        from transformers import masking_utils
+        from transformers.utils import fx as hf_fx
+    except ImportError:
+        yield
+        return
 
-        try:
-            from transformers import masking_utils
-            from transformers.utils import fx as hf_fx
-        except ImportError:
-            yield
-            return
+    def broadcast_for_bhqkv(mask_function, bh_indices=True):
+        def fn(batch_idx, head_idx, q_idx, kv_idx):
+            if bh_indices:
+                q = q_idx.reshape(1, 1, -1, 1)
+                kv = kv_idx.reshape(1, 1, 1, -1)
+                if batch_idx is not None:
+                    batch_idx = batch_idx.reshape(-1, 1, 1, 1)
+                if head_idx is not None:
+                    head_idx = head_idx.reshape(1, -1, 1, 1)
+            else:
+                q = q_idx.reshape(-1, 1)
+                kv = kv_idx.reshape(1, -1)
+            return mask_function(batch_idx, head_idx, q, kv)
+        return fn
 
-        def broadcast_for_bhqkv(mask_function, bh_indices=True):
-            def fn(batch_idx, head_idx, q_idx, kv_idx):
-                if bh_indices:
-                    q = q_idx.reshape(1, 1, -1, 1)
-                    kv = kv_idx.reshape(1, 1, 1, -1)
-                    if batch_idx is not None:
-                        batch_idx = batch_idx.reshape(-1, 1, 1, 1)
-                    if head_idx is not None:
-                        head_idx = head_idx.reshape(1, -1, 1, 1)
-                else:
-                    q = q_idx.reshape(-1, 1)
-                    kv = kv_idx.reshape(1, -1)
-                return mask_function(batch_idx, head_idx, q, kv)
-            return fn
+    orig_vmap = getattr(masking_utils, "_vmap_for_bhqkv", None)
+    orig_iter = hf_fx.HFTracer.iter
 
-        orig_vmap = getattr(masking_utils, "_vmap_for_bhqkv", None)
-        orig_iter = hf_fx.HFTracer.iter
+    def iter_with_meta(self, obj):
+        meta = getattr(obj, "_metadata", None)
+        if isinstance(meta, (torch.Size, tuple)):
+            return iter([obj[i] for i in range(len(meta))])
+        return orig_iter(self, obj)
 
-        def iter_with_meta(self, obj):
-            meta = getattr(obj, "_metadata", None)
-            if isinstance(meta, (torch.Size, tuple)):
-                return iter([obj[i] for i in range(len(meta))])
-            return orig_iter(self, obj)
-
+    if orig_vmap is not None:
+        masking_utils._vmap_for_bhqkv = broadcast_for_bhqkv
+    hf_fx.HFTracer.iter = iter_with_meta
+    try:
+        yield
+    finally:
         if orig_vmap is not None:
-            masking_utils._vmap_for_bhqkv = broadcast_for_bhqkv
-        hf_fx.HFTracer.iter = iter_with_meta
-        try:
-            yield
-        finally:
-            if orig_vmap is not None:
-                masking_utils._vmap_for_bhqkv = orig_vmap
-            hf_fx.HFTracer.iter = orig_iter
-
-    return cm()
+            masking_utils._vmap_for_bhqkv = orig_vmap
+        hf_fx.HFTracer.iter = orig_iter
 
 
 class PyTorchModel:

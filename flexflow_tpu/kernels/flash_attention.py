@@ -111,15 +111,18 @@ def _causal_num_kb(q_idx, block_q, block_k, num_kb, offset):
 def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       block_k: int, seq_k: int, causal: bool,
                       sm_scale: float, causal_offset: int = 0,
-                      dropout: float = 0.0):
+                      dropout: float = 0.0, num_heads: int = 1):
+    # 4D blocks with grid (batch, head, q_block): no (b*h) merge reshape at
+    # the kernel boundary — the profiled layout copies it forced (~8% of a
+    # BERT-Large step) disappear
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    q = q_ref[...]  # (block_q, d) — kept in input dtype: bf16 feeds the MXU
+    q = q_ref[0, 0]  # (block_q, d) — kept in input dtype: bf16 feeds the MXU
     block_q = q.shape[0]
-    bh = pl.program_id(0)
-    q_idx = pl.program_id(1)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    q_idx = pl.program_id(2)
 
     m = jnp.full((block_q,), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -129,8 +132,8 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T,
                     preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
         if causal:
@@ -162,10 +165,10 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # lse block is (block_q, 1): TPU tiling wants >=2-D blocks whose minor dim
-    # matches the array (a bare (block_q,) slice of (bh, seq) is rejected)
-    lse_ref[...] = (m + jnp.log(l_safe))[:, None].astype(lse_ref.dtype)
+    # matches the array (a bare (block_q,) slice of (b, h, seq) is rejected)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None].astype(lse_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -179,45 +182,42 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     sm_scale = 1.0 / np.sqrt(d)
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
-
-    qr = q.reshape(batch * heads, seq_q, d)
-    kr = k.reshape(batch * heads, seq_k, d)
-    vr = v.reshape(batch * heads, seq_k, d)
     seed_arr = jnp.reshape(jnp.asarray(
         seed if seed is not None else 0, jnp.uint32), (1,))
 
-    grid = (batch * heads, seq_q // block_q)
+    grid = (batch, heads, seq_q // block_q)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                seq_k=seq_k, causal=causal, sm_scale=sm_scale,
-                               causal_offset=seq_k - seq_q, dropout=dropout)
+                               causal_offset=seq_k - seq_q, dropout=dropout,
+                               num_heads=heads)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda b, i: (0,)),
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1,), lambda b, h, i: (0,)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(seed_arr, qr, kr, vr)
-    return (out.reshape(batch, heads, seq_q, d),
-            lse.reshape(batch, heads, seq_q))
+    )(seed_arr, q, k, v)
+    return out, lse.reshape(batch, heads, seq_q)
 
 
 def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, *, block_q: int,
                           seq_q: int, causal: bool, sm_scale: float,
-                          causal_offset: int = 0, dropout: float = 0.0):
-    """Grid (batch*heads, seq_k//block_k): one (dk, dv) tile per k block,
+                          causal_offset: int = 0, dropout: float = 0.0,
+                          num_heads: int = 1):
+    """Grid (batch, heads, seq_k//block_k): one (dk, dv) tile per k block,
     streaming q/do/lse/delta blocks — the FlashAttention-2 backward split.
 
     With dropout (mask D regenerated from the same counters as forward):
@@ -228,12 +228,12 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    k = k_ref[...]  # (block_k, d)
-    v = v_ref[...]
+    k = k_ref[0, 0]  # (block_k, d)
+    v = v_ref[0, 0]
     block_k = k.shape[0]
     d = k.shape[1]
-    bh = pl.program_id(0)
-    kb = pl.program_id(1)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    kb = pl.program_id(2)
 
     dk = jnp.zeros((block_k, d), jnp.float32)
     dv = jnp.zeros((block_k, d), jnp.float32)
@@ -241,10 +241,10 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :]
-        do = do_ref[pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[pl.ds(qb * block_q, block_q), :]  # (bq, 1) f32
-        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # (bq, 1) f32
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _apply_causal_mask(s, qb * block_q, kb * block_k,
@@ -272,34 +272,35 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     else:
         qb_start = 0
     dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk, dv))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, *, block_k: int, seq_k: int,
                          causal: bool, sm_scale: float,
-                         causal_offset: int = 0, dropout: float = 0.0):
-    """Grid (batch*heads, seq_q//block_q): one dq tile per q block."""
+                         causal_offset: int = 0, dropout: float = 0.0,
+                         num_heads: int = 1):
+    """Grid (batch, heads, seq_q//block_q): one dq tile per q block."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    q = q_ref[...]  # (block_q, d)
-    do = do_ref[...]
-    lse = lse_ref[...]  # (block_q, 1)
-    delta = delta_ref[...]
+    q = q_ref[0, 0]  # (block_q, d)
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # (block_q, 1)
+    delta = delta_ref[0, 0]
     block_q = q.shape[0]
     d = q.shape[1]
-    bh = pl.program_id(0)
-    qb = pl.program_id(1)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    qb = pl.program_id(2)
 
     dq = jnp.zeros((block_q, d), jnp.float32)
     num_kb = seq_k // block_k
 
     def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _apply_causal_mask(s, qb * block_q, kb * block_k,
@@ -320,7 +321,7 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq = jax.lax.fori_loop(0, num_kb_eff, body, dq)
     else:
         dq = jax.lax.fori_loop(0, num_kb, body, dq)
-    dq_ref[...] = dq.astype(dq_ref.dtype)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
@@ -336,54 +337,51 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
 
-    qr = q.reshape(batch * heads, seq_q, d)
-    kr = k.reshape(batch * heads, seq_k, d)
-    vr = v.reshape(batch * heads, seq_k, d)
-    dor = do.reshape(batch * heads, seq_q, d).astype(q.dtype)
-    lser = lse.reshape(batch * heads, seq_q, 1)
+    dor = do.astype(q.dtype)
+    lser = lse.reshape(batch, heads, seq_q, 1)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(batch * heads, seq_q, 1)
+                    axis=-1, keepdims=True)
     seed_arr = jnp.reshape(jnp.asarray(
         seed if seed is not None else 0, jnp.uint32), (1,))
 
-    seed_spec = pl.BlockSpec((1,), lambda b, i: (0,))
-    full_q = pl.BlockSpec((None, seq_q, d), lambda b, i: (b, 0, 0))
-    full_q1 = pl.BlockSpec((None, seq_q, 1), lambda b, i: (b, 0, 0))
-    full_k = pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0))
-    tile_q = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
-    tile_q1 = pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0))
-    tile_k = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0))
+    seed_spec = pl.BlockSpec((1,), lambda b, h, i: (0,))
+    full_q = pl.BlockSpec((1, 1, seq_q, d), lambda b, h, i: (b, h, 0, 0))
+    full_q1 = pl.BlockSpec((1, 1, seq_q, 1), lambda b, h, i: (b, h, 0, 0))
+    full_k = pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0))
+    tile_q = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0))
+    tile_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0))
+    tile_k = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i: (b, h, i, 0))
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal,
-        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout)
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout,
+        num_heads=heads)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(batch * heads, seq_k // block_k),
+        grid=(batch, heads, seq_k // block_k),
         in_specs=[seed_spec, full_q, tile_k, tile_k, full_q, full_q1,
                   full_q1],
         out_specs=[tile_k, tile_k],
-        out_shape=[jax.ShapeDtypeStruct((batch * heads, seq_k, d), k.dtype),
-                   jax.ShapeDtypeStruct((batch * heads, seq_k, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((batch, heads, seq_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((batch, heads, seq_k, d), v.dtype)],
         interpret=interpret,
-    )(seed_arr, qr, kr, vr, dor, lser, delta)
+    )(seed_arr, q, k, v, dor, lser, delta)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal,
-        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout)
+        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout,
+        num_heads=heads)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(batch * heads, seq_q // block_q),
+        grid=(batch, heads, seq_q // block_q),
         in_specs=[seed_spec, tile_q, full_k, full_k, tile_q, tile_q1,
                   tile_q1],
         out_specs=tile_q,
-        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq_q, d), q.dtype),
         interpret=interpret,
-    )(seed_arr, qr, kr, vr, dor, lser, delta)
+    )(seed_arr, q, k, v, dor, lser, delta)
 
-    return (dq.reshape(batch, heads, seq_q, d),
-            dk.reshape(batch, heads, seq_k, d),
-            dv.reshape(batch, heads, seq_k, d))
+    return dq, dk, dv
 
 
 def _reference_core(q, k, v, causal: bool):

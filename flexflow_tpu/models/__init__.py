@@ -1,7 +1,10 @@
 """Model zoo matching the reference's example apps (SURVEY §2.5):
 AlexNet/CIFAR-10, ResNet-50, ResNeXt-50, InceptionV3, Transformer, BERT-Large,
-DLRM, XDL, MLP_Unify, CANDLE-Uno, MoE, NMT (LSTM seq2seq)."""
+GPT-2 (decoder-only causal LM), DLRM, XDL, MLP_Unify, CANDLE-Uno, MoE,
+NMT (LSTM seq2seq)."""
 from .bert import BertConfig, build_bert, bert_param_count  # noqa: F401
+from .gpt2 import (GPT2Config, build_gpt2,  # noqa: F401
+                   gpt2_param_count, gpt2_train_flops_per_step)
 from .vision import (build_alexnet, build_alexnet_cifar10,  # noqa: F401
                      build_resnet50, build_resnext50, build_inception_v3)
 from .dlrm import build_dlrm  # noqa: F401
