@@ -146,15 +146,28 @@ def main():
     if on_tpu:
         result.update(cost_model_checks(ff, config, dt,
                                         example_batch=(xd, yd)))
-        result.update(dropout_mfu_leg(cfg, flops_per_step, peak))
+        result.update(dropout_mfu_leg(cfg, peak))
+        result.update(long_context_leg(peak))
     print(json.dumps(result))
 
 
-def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
-    """Real-pretraining shape: attention dropout 0.1 stays ON the in-kernel
-    flash path (VERDICT r3 item 3 Done criterion: >= 0.5 MFU with dropout;
-    previously the op silently fell back to the einsum core)."""
-    import dataclasses
+def long_context_leg(peak) -> dict:
+    """Long-context flash leg: seq 4096 on one chip. The einsum core would
+    materialize a 1 GiB f32 score block per layer per direction; the Pallas
+    kernel streams it, so long sequences train at full-model scale (the
+    long-context-first design goal, SURVEY §5)."""
+    from flexflow_tpu.models.bert import BertConfig
+
+    return _timed_leg(BertConfig(batch_size=1, seq_len=4096, hidden=1024,
+                                 num_heads=16, num_layers=8,
+                                 intermediate=4096), peak, "seq4096")
+
+
+def _timed_leg(cfg, peak, suffix: str) -> dict:
+    """Build + train-step-time one BertConfig with the SAME median-of-3
+    20-iter-window recipe as the headline number (single windows swing ~8%
+    on the tunneled chip; short windows pay the ~75 ms readback RTT over
+    too few steps). Returns {mfu_<suffix>, step_ms_<suffix>} or an error."""
     import time
 
     import jax
@@ -163,24 +176,24 @@ def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
 
     from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, \
         LossType
-    from flexflow_tpu.models.bert import build_bert
+    from flexflow_tpu.models.bert import (bert_train_flops_per_step,
+                                          build_bert)
 
     out = {}
     try:
-        cfg2 = dataclasses.replace(cfg, dropout=0.1)
         config = FFConfig()
-        config.batch_size = cfg2.batch_size
+        config.batch_size = cfg.batch_size
         config.compute_dtype = DataType.DT_BFLOAT16
         ff = FFModel(config)
-        build_bert(ff, cfg2)
+        build_bert(ff, cfg)
         ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
                    loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
         step = ff.executor.make_train_step()
         rng = np.random.default_rng(0)
-        x = rng.normal(size=(cfg2.batch_size, cfg2.seq_len, cfg2.hidden)
+        x = rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
                        ).astype(np.float32)
-        y = rng.integers(0, cfg2.num_classes,
-                         size=(cfg2.batch_size, 1)).astype(np.int32)
+        y = rng.integers(0, cfg.num_classes,
+                         size=(cfg.batch_size, 1)).astype(np.int32)
         xd = [jax.device_put(x, ff.executor.batch_sharding(3))]
         yd = jax.device_put(y, ff.executor.batch_sharding(2))
         params, opt_state = ff.params, ff.opt_state
@@ -188,9 +201,6 @@ def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
             params, opt_state, loss, _ = step(params, opt_state, xd, yd,
                                               jrandom.PRNGKey(i))
         _ = float(loss)
-        # same median-of-3-windows recipe as the headline number (single
-        # windows swing ~8% on the tunneled chip; short windows also pay
-        # the ~75 ms readback RTT over too few steps)
         iters = 20
         windows = []
         for w in range(3):
@@ -202,11 +212,22 @@ def dropout_mfu_leg(cfg, flops_per_step, peak) -> dict:
             _ = float(loss)
             windows.append((time.perf_counter() - t0) / iters)
         dt = sorted(windows)[1]
-        out["mfu_dropout01"] = round(flops_per_step / dt / peak, 4)
-        out["step_ms_dropout01"] = round(dt * 1e3, 2)
+        fl = bert_train_flops_per_step(cfg)
+        out[f"mfu_{suffix}"] = round(fl / dt / peak, 4)
+        out[f"step_ms_{suffix}"] = round(dt * 1e3, 2)
     except Exception as e:
-        out["dropout_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+        out[f"{suffix}_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
+
+
+def dropout_mfu_leg(cfg, peak) -> dict:
+    """Real-pretraining shape: attention dropout 0.1 stays ON the in-kernel
+    flash path (VERDICT r3 item 3 Done criterion: >= 0.5 MFU with dropout;
+    previously the op silently fell back to the einsum core)."""
+    import dataclasses
+
+    return _timed_leg(dataclasses.replace(cfg, dropout=0.1), peak,
+                      "dropout01")
 
 
 def cost_model_checks(ff, config, measured_step_s: float,
