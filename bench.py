@@ -24,6 +24,12 @@ PEAK_FLOPS = {
     "v6e": 918e12,
 }
 
+# ONE window length shared by the headline and every timed leg (ADVICE r4:
+# they drifted to 30 vs 20). Each timing window ends in a single host
+# readback costing ~75 ms RTT on the tunneled platform; at 60 iters that
+# inflates each step by ~1.25 ms (documented in BASELINE.md).
+BENCH_ITERS = 60
+
 
 def detect_peak_flops():
     import jax
@@ -78,10 +84,7 @@ def main():
     if on_tpu:
         cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
                          num_heads=16, num_layers=24, intermediate=4096)
-        # 30 iters/window: the tunneled platform pays one ~75 ms RTT for
-        # the end-of-window loss readback — over 10 iters that inflated
-        # every step by ~7.5 ms (round-3 profile, BASELINE.md breakdown)
-        warmup, iters = 3, 30
+        warmup, iters = 3, BENCH_ITERS
     else:  # CI smoke path
         cfg = BertConfig.tiny(batch_size=8)
         warmup, iters = 1, 3
@@ -165,8 +168,8 @@ def long_context_leg(peak) -> dict:
 
 def _timed_leg(cfg, peak, suffix: str) -> dict:
     """Build + train-step-time one BertConfig with the SAME median-of-3
-    20-iter-window recipe as the headline number (single windows swing ~8%
-    on the tunneled chip; short windows pay the ~75 ms readback RTT over
+    BENCH_ITERS-window recipe as the headline number (single windows swing
+    ~8% on the tunneled chip; short windows pay the ~75 ms readback RTT over
     too few steps). Returns {mfu_<suffix>, step_ms_<suffix>} or an error."""
     import time
 
@@ -201,7 +204,7 @@ def _timed_leg(cfg, peak, suffix: str) -> dict:
             params, opt_state, loss, _ = step(params, opt_state, xd, yd,
                                               jrandom.PRNGKey(i))
         _ = float(loss)
-        iters = 20
+        iters = BENCH_ITERS
         windows = []
         for w in range(3):
             t0 = time.perf_counter()

@@ -7,6 +7,22 @@ FlashAttention recipe tiled for the MXU (128-aligned blocks) with VMEM
 accumulators. Backward uses the recompute trick via ``jax.custom_vjp``: the
 residuals are only (out, logsumexp), so long sequences fit in HBM.
 
+Streaming grids (round 5): every kernel walks K/V (or Q) tiles through a
+Pallas grid dimension instead of holding the full sequence resident in VMEM,
+so per-program VMEM is O(block) — Pallas double-buffers the tile DMAs against
+compute automatically and max sequence length is bounded by HBM, not VMEM.
+Backward has two schedules:
+
+- **fused one-pass** (``seq_q * d * 10 ≤ FUSED_BWD_RESIDENT_BUDGET``): grid
+  over K/V tiles,
+  Q/dO resident, dq accumulated in a (seq_q, d) f32 scratch. Computes the
+  probabilities ONCE per (q, k) tile and reuses them for dq, dk and dv —
+  vs. the two-pass schedule this halves the exp/VPU work and drops two of
+  the six MXU passes (score + dO·Vᵀ recomputation).
+- **two-pass streaming** (arbitrary seq): FlashAttention-2-style separate
+  dkv and dq kernels, each O(block) VMEM, for sequences whose Q residency
+  would not fit VMEM.
+
 Falls back transparently to the einsum core off-TPU (interpret mode is used in
 tests)."""
 from __future__ import annotations
@@ -19,6 +35,16 @@ import numpy as np
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+# Above this, the fused backward's Q/dO/dq residency (~10*seq_q*d bytes)
+# no longer fits VMEM comfortably -> two-pass streaming schedule.
+# Fused-backward residency budget: Q/dO/O/dq-out (bf16) + dq scratch (f32)
+# come to ~10*seq_q*d bytes; past this the schedule no longer fits the 16 MB
+# VMEM scope next to the in-flight score tiles -> two-pass streaming.
+# (5 MB == seq_q 8192 at d=64, 4096 at d=128.)
+FUSED_BWD_RESIDENT_BUDGET = 5 * 2 ** 20
+# Unroll the fused backward's q loop with STATIC slices up to this many
+# tiles (dynamic-slice reads defeat the Mosaic vectorizer, ~10% on v5e).
+MAX_UNROLL_QB = 16
 NEG_INF = -1e30
 
 
@@ -100,75 +126,124 @@ def _apply_causal_mask(s, q_start, k_start, offset, block_q, block_k):
     return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
 
 
-def _causal_num_kb(q_idx, block_q, block_k, num_kb, offset):
-    """Number of leading key blocks that contribute to query block q_idx."""
+def _tile_contributes(q_idx, kb, block_q, block_k, offset):
+    """Traced bool: does tile (q_idx, kb) intersect the causal band?
+    True iff the tile's largest q_pos + offset reaches its smallest k_pos."""
+    return q_idx * block_q + block_q - 1 + offset >= kb * block_k
+
+
+def _first_contributing_qb(kb, block_q, block_k, offset):
+    """Smallest q-block index intersecting the causal band for key block kb
+    (tight: qb*block_q <= kb*block_k - offset < (qb+1)*block_q ⇒ the tile's
+    last row reaches the band and qb-1's does not)."""
     import jax.numpy as jnp
 
-    last = ((q_idx + 1) * block_q + offset + block_k - 1) // block_k
-    return jnp.clip(last, 0, num_kb)
+    return jnp.maximum(kb * block_k - offset, 0) // block_q
 
 
-def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      block_k: int, seq_k: int, causal: bool,
-                      sm_scale: float, causal_offset: int = 0,
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, *, num_kb: int, causal: bool,
+                      causal_offset: int = 0,
                       dropout: float = 0.0, num_heads: int = 1):
-    # 4D blocks with grid (batch, head, q_block): no (b*h) merge reshape at
-    # the kernel boundary — the profiled layout copies it forced (~8% of a
-    # BERT-Large step) disappear
-    import jax
+    """Grid (batch, head, q_block, k_block), k innermost: one (q, k) score
+    tile per program, online-softmax state (m, l, acc) carried across the k
+    grid dimension in VMEM scratch (m/l lane-replicated to (block_q, 128)
+    for layout). K/V tiles stream through the grid — Pallas double-buffers
+    their DMAs — so VMEM residency is O(block), not O(seq_k). All tile
+    accesses are static BlockSpec blocks: a register-carried
+    fori_loop-over-pl.ds variant measured ~10% slower on v5e (dynamic-slice
+    reads defeat the Mosaic vectorizer), so one tile per grid step it is.
+
+    Q arrives PRE-SCALED by 1/sqrt(d) (folded into the projection by XLA),
+    so no kernel multiplies the (block_q, block_k) score tile by sm_scale —
+    that VPU pass (~270M multiplies/layer at seq 4096) is free."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0]  # (block_q, d) — kept in input dtype: bf16 feeds the MXU
-    block_q = q.shape[0]
-    bh = pl.program_id(0) * num_heads + pl.program_id(1)
     q_idx = pl.program_id(2)
+    kb = pl.program_id(3)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    if num_kb == 1:
+        # single k block: the whole softmax row is in registers — skip the
+        # scratch round-trip entirely (measured ~0.1 ms/layer at b8 s512)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _apply_causal_mask(s, q_idx * block_q, 0, causal_offset,
+                                   block_q, block_k)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            p = p * dropout_keep_scale(seed_ref[0], bh, q_idx * block_q, 0,
+                                       block_q, block_k, dropout)
+        acc = jnp.dot(p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+        return
 
-    num_kb = seq_k // block_k
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T,
-                    preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+    def _tile():
+        q = q_ref[0, 0]  # (block_q, d) — input dtype: bf16 feeds the MXU
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, q_idx * block_q, kb * block_k,
                                    causal_offset, block_q, block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_scr[...]  # (block_q, 128), lanes replicated
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
+        alpha = jnp.exp(m_prev - m_new)
         # softmax normalizer from UNDROPPED p: dropout applies to the
         # normalized probabilities, and elementwise mask/scale commutes
         # with the 1/l normalization
-        l_new = l * alpha + jnp.sum(p, axis=-1)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout > 0.0:
-            p_acc = p * dropout_keep_scale(seed_ref[0], bh,
-                                           q_idx * block_q, kb * block_k,
-                                           block_q, block_k, dropout)
-        else:
-            p_acc = p
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p_acc.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+            p = p * dropout_keep_scale(seed_ref[0], bh, q_idx * block_q,
+                                       kb * block_k, block_q, block_k,
+                                       dropout)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     if causal:
-        # only key blocks up to the (offset-shifted) diagonal contribute
-        num_kb_eff = _causal_num_kb(q_idx, block_q, block_k, num_kb,
-                                    causal_offset)
-        m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m, l, acc))
+        @pl.when(_tile_contributes(q_idx, kb, block_q, block_k,
+                                   causal_offset))
+        def _run():
+            _tile()
     else:
-        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+        _tile()
 
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse block is (block_q, 1): TPU tiling wants >=2-D blocks whose minor dim
-    # matches the array (a bare (block_q,) slice of (b, h, seq) is rejected)
-    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None].astype(lse_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _final():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        # lse block is (block_q, 1): TPU tiling wants >=2-D blocks whose
+        # minor dim matches the array
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+def _compiler_params(interpret: bool, semantics):
+    if interpret:
+        return None
+    import jax.experimental.pallas.tpu as pltpu
+
+    return pltpu.CompilerParams(dimension_semantics=semantics)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -176,49 +251,71 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
 
     batch, heads, seq_q, d = q.shape
     seq_k = k.shape[2]
-    sm_scale = 1.0 / np.sqrt(d)
+    # pre-scale q outside the kernel: XLA fuses the multiply into the
+    # producing projection, and the per-score-element sm_scale VPU pass
+    # disappears from the kernel (exact for d = 4^k, e.g. 1/8 at d=64)
+    q = (q * np.float32(1.0 / np.sqrt(d))).astype(q.dtype)
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
     seed_arr = jnp.reshape(jnp.asarray(
         seed if seed is not None else 0, jnp.uint32), (1,))
 
-    grid = (batch, heads, seq_q // block_q)
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               seq_k=seq_k, causal=causal, sm_scale=sm_scale,
+    num_kb = seq_k // block_k
+    grid = (batch, heads, seq_q // block_q, num_kb)
+    kernel = functools.partial(_flash_fwd_kernel, num_kb=num_kb,
+                               causal=causal,
                                causal_offset=seq_k - seq_q, dropout=dropout,
                                num_heads=heads)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda b, h, i: (0,)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1,), lambda b, h, i, j: (0,)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch, heads, seq_q, d), q.dtype),
             jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(
+            interpret, ("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed_arr, q, k, v)
     return out, lse.reshape(batch, heads, seq_q)
 
 
-def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          delta_ref, dk_ref, dv_ref, *, block_q: int,
-                          seq_q: int, causal: bool, sm_scale: float,
-                          causal_offset: int = 0, dropout: float = 0.0,
-                          num_heads: int = 1):
-    """Grid (batch, heads, seq_k//block_k): one (dk, dv) tile per k block,
-    streaming q/do/lse/delta blocks — the FlashAttention-2 backward split.
+def _flash_bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            o_ref, dq_ref, dk_ref, dv_ref, dq_scr, *,
+                            block_q: int, seq_q: int, num_kb: int,
+                            causal: bool, sm_scale: float,
+                            causal_offset: int = 0, dropout: float = 0.0,
+                            num_heads: int = 1):
+    """Fused one-pass backward, grid (batch, head, k_block): K/V tiles
+    stream through the grid while Q/dO/lse/O stay resident per (b, h);
+    dq accumulates in a (seq_q, d) f32 scratch carried across the k grid
+    dimension and is flushed on the last k block. Each (q, k) tile computes
+    the probabilities ONCE and derives dv, dk and dq from them — the
+    two-pass schedule pays the score matmul, dO·Vᵀ matmul and the exp twice.
+    δ = rowsum(dO∘O) is computed in-register from the resident tiles rather
+    than as a separate HBM-roundtrip fusion before the kernel.
+
+    Q arrives PRE-SCALED by 1/sqrt(d): s needs no scale, dk = dSᵀ·(q/√d)
+    absorbs it exactly, and only the dq flush multiplies by sm_scale once.
 
     With dropout (mask D regenerated from the same counters as forward):
     dV = (P∘D)ᵀ dO and dS = P ∘ (D∘dP - δ) — δ = rowsum(dO∘O) already
@@ -228,24 +325,34 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    kb = pl.program_id(2)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
     k = k_ref[0, 0]  # (block_k, d)
     v = v_ref[0, 0]
     block_k = k.shape[0]
     d = k.shape[1]
-    bh = pl.program_id(0) * num_heads + pl.program_id(1)
-    kb = pl.program_id(2)
 
-    dk = jnp.zeros((block_k, d), jnp.float32)
-    dv = jnp.zeros((block_k, d), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
     num_qb = seq_q // block_q
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
 
-    def body(qb, carry):
+    def body(qb, carry, sl=None):
+        """One (q, k) tile; ``sl`` carries static slices when unrolled —
+        dynamic-slice reads measurably defeat the Mosaic vectorizer."""
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # (bq, 1) f32
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if sl is None:
+            sl = pl.ds(qb * block_q, block_q)
+        q = q_ref[0, 0, sl, :]
+        do = do_ref[0, 0, sl, :]
+        lse = lse_ref[0, 0, sl, :]  # (bq, 1) f32
+        o = o_ref[0, 0, sl, :]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, qb * block_q, kb * block_k,
                                    causal_offset, block_q, block_k)
@@ -261,47 +368,130 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             pd = p
         dv = dv + jnp.dot(pd.astype(do.dtype).T, do,
                           preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
         dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
                           preferred_element_type=jnp.float32)
+        dq_scr[sl, :] = (dq_scr[sl, :]
+                         + jnp.dot(ds.astype(k.dtype), k,
+                                   preferred_element_type=jnp.float32))
         return dk, dv
 
     if causal:
-        # first q block with any q_pos + offset >= kb*block_k
-        qb_start = jnp.maximum(kb * block_k - causal_offset, 0) // block_q
+        # the loop start is traced (depends on kb), so the static unroll
+        # below does not apply; masked tiles would vanish numerically
+        # (p == 0) but cost full compute, so keep the skip via fori_loop
+        qb_start = _first_contributing_qb(kb, block_q, block_k,
+                                          causal_offset)
+        dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk0, dv0))
+    elif num_qb <= MAX_UNROLL_QB:
+        # non-causal: every tile contributes — unroll with static slices
+        dk, dv = dk0, dv0
+        for qb in range(num_qb):
+            dk, dv = body(qb, (dk, dv),
+                          sl=slice(qb * block_q, (qb + 1) * block_q))
     else:
-        qb_start = 0
-    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk, dv))
+        dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
+    @pl.when(kb == num_kb - 1)
+    def _final():
+        dq_ref[0, 0] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
 
-def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         delta_ref, dq_ref, *, block_k: int, seq_k: int,
-                         causal: bool, sm_scale: float,
-                         causal_offset: int = 0, dropout: float = 0.0,
-                         num_heads: int = 1):
-    """Grid (batch, heads, seq_q//block_q): one dq tile per q block."""
-    import jax
+
+def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          num_qb: int, causal: bool,
+                          causal_offset: int = 0, dropout: float = 0.0,
+                          num_heads: int = 1):
+    """Two-pass schedule, dkv kernel: grid (batch, head, k_block, q_block),
+    q innermost. K/V tiles are resident per k block; Q/dO/lse/delta tiles
+    stream through the q grid dimension; (dk, dv) accumulate in VMEM scratch
+    carried across it (the FlashAttention-2 backward split, with O(block)
+    VMEM for arbitrarily long sequences)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0]  # (block_q, d)
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # (block_q, 1)
-    delta = delta_ref[0, 0]
-    block_q = q.shape[0]
-    d = q.shape[1]
+    kb = pl.program_id(2)
+    qb = pl.program_id(3)
     bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    k = k_ref[0, 0]  # (block_k, d)
+    v = v_ref[0, 0]
+    block_k = k.shape[0]
+    block_q = q_ref.shape[2]
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _tile():
+        q = q_ref[0, 0]  # pre-scaled by 1/sqrt(d): dk = dSᵀ·(q/√d) exactly
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (bq, 1) f32
+        delta = delta_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _apply_causal_mask(s, qb * block_q, kb * block_k,
+                                   causal_offset, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            keep = dropout_keep_scale(seed_ref[0], bh, qb * block_q,
+                                      kb * block_k, block_q, block_k,
+                                      dropout)
+            pd = p * keep
+            dp = dp * keep
+        else:
+            pd = p
+        dv_scr[...] = dv_scr[...] + jnp.dot(
+            pd.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] = dk_scr[...] + jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(_tile_contributes(qb, kb, block_q, block_k, causal_offset))
+        def _run():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(qb == num_qb - 1)
+    def _final():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, dq_scr, *, num_kb: int,
+                         causal: bool, sm_scale: float,
+                         causal_offset: int = 0, dropout: float = 0.0,
+                         num_heads: int = 1):
+    """Two-pass schedule, dq kernel: grid (batch, head, q_block, k_block),
+    k innermost. Q/dO/lse/delta resident per q block; K/V tiles stream
+    through the k grid dimension; dq accumulates in scratch."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
     qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    bh = pl.program_id(0) * num_heads + pl.program_id(1)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
 
-    dq = jnp.zeros((block_q, d), jnp.float32)
-    num_kb = seq_k // block_k
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    def body(kb, dq):
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    def _tile():
+        q = q_ref[0, 0]  # pre-scaled by 1/sqrt(d)
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = _apply_causal_mask(s, qb * block_q, kb * block_k,
                                    causal_offset, block_q, block_k)
@@ -311,73 +501,122 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dp = dp * dropout_keep_scale(seed_ref[0], bh, qb * block_q,
                                          kb * block_k, block_q, block_k,
                                          dropout)
-        ds = p * (dp - delta) * sm_scale
-        return dq + jnp.dot(ds.astype(k.dtype), k,
-                            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
     if causal:
-        num_kb_eff = _causal_num_kb(qb, block_q, block_k, num_kb,
-                                    causal_offset)
-        dq = jax.lax.fori_loop(0, num_kb_eff, body, dq)
+        @pl.when(_tile_contributes(qb, kb, block_q, block_k, causal_offset))
+        def _run():
+            _tile()
     else:
-        dq = jax.lax.fori_loop(0, num_kb, body, dq)
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+        _tile()
+
+    @pl.when(kb == num_kb - 1)
+    def _final():
+        dq_ref[0, 0] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
                     block_k: int, interpret: bool, dropout: float = 0.0,
-                    seed=None):
+                    seed=None, fused: Optional[bool] = None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
 
     batch, heads, seq_q, d = q.shape
     seq_k = k.shape[2]
     sm_scale = 1.0 / np.sqrt(d)
+    # q pre-scaled as in the forward: the kernels recompute the identical s
+    q = (q * np.float32(sm_scale)).astype(q.dtype)
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
 
     dor = do.astype(q.dtype)
     lser = lse.reshape(batch, heads, seq_q, 1)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)
     seed_arr = jnp.reshape(jnp.asarray(
         seed if seed is not None else 0, jnp.uint32), (1,))
 
-    seed_spec = pl.BlockSpec((1,), lambda b, h, i: (0,))
-    full_q = pl.BlockSpec((1, 1, seq_q, d), lambda b, h, i: (b, h, 0, 0))
-    full_q1 = pl.BlockSpec((1, 1, seq_q, 1), lambda b, h, i: (b, h, 0, 0))
-    full_k = pl.BlockSpec((1, 1, seq_k, d), lambda b, h, i: (b, h, 0, 0))
-    tile_q = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0))
-    tile_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0))
-    tile_k = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i: (b, h, i, 0))
+    seed_spec = pl.BlockSpec((1,), lambda *_: (0,))
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+    if fused is None:
+        fused = seq_q * d * 10 <= FUSED_BWD_RESIDENT_BUDGET
 
+    if fused:
+        # grid (b, h, kb): Q/dO/O resident, dq in (seq_q, d) scratch;
+        # delta is computed in-kernel from the resident dO/O tiles
+        full_q = pl.BlockSpec((1, 1, seq_q, d), lambda b, h, j: (b, h, 0, 0))
+        full_q1 = pl.BlockSpec((1, 1, seq_q, 1), lambda b, h, j: (b, h, 0, 0))
+        tile_k = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0))
+        kernel = functools.partial(
+            _flash_bwd_fused_kernel, block_q=block_q, seq_q=seq_q,
+            num_kb=num_kb, causal=causal, sm_scale=sm_scale,
+            causal_offset=seq_k - seq_q, dropout=dropout, num_heads=heads)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(batch, heads, num_kb),
+            in_specs=[seed_spec, full_q, tile_k, tile_k, full_q, full_q1,
+                      full_q],
+            out_specs=[full_q, tile_k, tile_k],
+            out_shape=[
+                jax.ShapeDtypeStruct((batch, heads, seq_q, d), q.dtype),
+                jax.ShapeDtypeStruct((batch, heads, seq_k, d), k.dtype),
+                jax.ShapeDtypeStruct((batch, heads, seq_k, d), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((seq_q, d), jnp.float32)],
+            compiler_params=_compiler_params(
+                interpret, ("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(seed_arr, q, k, v, dor, lser, out)
+        return dq, dk, dv
+
+    # two-pass streaming schedule: O(block) VMEM at any sequence length
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    tile_q_kv = pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, j, i: (b, h, i, 0))
+    tile_q1_kv = pl.BlockSpec((1, 1, block_q, 1),
+                              lambda b, h, j, i: (b, h, i, 0))
+    res_k = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0))
     dkv_kernel = functools.partial(
-        _flash_bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal,
-        sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout,
+        _flash_bwd_dkv_kernel, num_qb=num_qb, causal=causal,
+        causal_offset=seq_k - seq_q, dropout=dropout,
         num_heads=heads)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(batch, heads, seq_k // block_k),
-        in_specs=[seed_spec, full_q, tile_k, tile_k, full_q, full_q1,
-                  full_q1],
-        out_specs=[tile_k, tile_k],
+        grid=(batch, heads, num_kb, num_qb),
+        in_specs=[seed_spec, tile_q_kv, res_k, res_k, tile_q_kv, tile_q1_kv,
+                  tile_q1_kv],
+        out_specs=[res_k, res_k],
         out_shape=[jax.ShapeDtypeStruct((batch, heads, seq_k, d), k.dtype),
                    jax.ShapeDtypeStruct((batch, heads, seq_k, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params(
+            interpret, ("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed_arr, q, k, v, dor, lser, delta)
 
+    res_q = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
+    res_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    tile_k_q = pl.BlockSpec((1, 1, block_k, d),
+                            lambda b, h, i, j: (b, h, j, 0))
     dq_kernel = functools.partial(
-        _flash_bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal,
+        _flash_bwd_dq_kernel, num_kb=num_kb, causal=causal,
         sm_scale=sm_scale, causal_offset=seq_k - seq_q, dropout=dropout,
         num_heads=heads)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(batch, heads, seq_q // block_q),
-        in_specs=[seed_spec, tile_q, full_k, full_k, tile_q, tile_q1,
-                  tile_q1],
-        out_specs=tile_q,
+        grid=(batch, heads, num_qb, num_kb),
+        in_specs=[seed_spec, res_q, tile_k_q, tile_k_q, res_q, res_q1,
+                  res_q1],
+        out_specs=res_q,
         out_shape=jax.ShapeDtypeStruct((batch, heads, seq_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(
+            interpret, ("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed_arr, q, k, v, dor, lser, delta)
 
@@ -400,9 +639,9 @@ def _reference_core(q, k, v, causal: bool):
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_attention_p(q, k, v, seed, causal, block_q, block_k, interpret,
-                       dropout):
+                       dropout, bwd_block_q, bwd_block_k):
     _check_causal_shape(q, k, causal)
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
                             _resolve_interpret(interpret),
@@ -410,11 +649,42 @@ def _flash_attention_p(q, k, v, seed, causal, block_q, block_k, interpret,
     return out
 
 
+def _bwd_blocks(block_q: int, block_k: int, bwd_block_q, bwd_block_k,
+                seq_q: int, seq_k: int):
+    """Backward defaults to the forward blocks with block_k capped at 512:
+    the fused backward keeps three (block_q, block_k) f32 score-sized tiles
+    in flight plus the dq scratch, so 1024-wide k tiles (the forward sweet
+    spot) overflow the 16 MB VMEM scope inside a full train step — and
+    (512, 512) measured the same 2.16 ms/layer as (512, 1024) on v5e.
+
+    Divisibility is re-checked against the sequences: a capped default that
+    no longer divides seq_k falls back to the (valid) forward block, and an
+    EXPLICIT non-dividing override raises — the grid floor-divisions would
+    otherwise silently drop the tail keys from dk/dv/dq."""
+    bq = bwd_block_q if bwd_block_q is not None else block_q
+    bk = bwd_block_k if bwd_block_k is not None else min(block_k, 512)
+    for name, blk, seq in (("bwd_block_q", bq, seq_q),
+                           ("bwd_block_k", bk, seq_k)):
+        if seq % min(blk, seq) != 0:
+            if (bwd_block_q if name == "bwd_block_q" else bwd_block_k) \
+                    is not None:
+                raise ValueError(
+                    f"flash_attention {name}={blk} does not divide "
+                    f"sequence length {seq}")
+    if seq_k % min(bk, seq_k) != 0:
+        bk = block_k  # forward block divides by the public contract
+    if seq_q % min(bq, seq_q) != 0:
+        bq = block_q
+    return bq, bk
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None,
-                    dropout: float = 0.0, seed=None):
+                    dropout: float = 0.0, seed=None,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None):
     """q,k,v: (batch, heads, seq, head_dim) -> (batch, heads, seq_q, head_dim).
 
     seq_q/seq_k must be multiples of the block sizes (the attention op checks
@@ -425,14 +695,14 @@ def flash_attention(q, k, v, causal: bool = False,
 
     ``dropout``/``seed``: in-kernel attention-probability dropout via a
     counter-based PRNG on global (batch*head, q_pos, k_pos) coordinates, so
-    forward and both backward kernels regenerate identical masks without
+    forward and both backward schedules regenerate identical masks without
     materializing them in HBM (the cuDNN-MHA dropout analog,
     reference src/ops/attention.cu:225). ``seed`` is a traced uint32 scalar
     — reseed per step without recompiling."""
     dropout = float(dropout)
     seed = coerce_dropout_seed("flash_attention", dropout, seed)
     return _flash_attention_p(q, k, v, seed, causal, block_q, block_k,
-                              interpret, dropout)
+                              interpret, dropout, bwd_block_q, bwd_block_k)
 
 
 def _check_causal_shape(q, k, causal: bool) -> None:
@@ -450,7 +720,8 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd(q, k, v, seed, causal, block_q, block_k, interpret, dropout):
+def _fwd(q, k, v, seed, causal, block_q, block_k, interpret, dropout,
+         bwd_block_q, bwd_block_k):
     _check_causal_shape(q, k, causal)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               _resolve_interpret(interpret),
@@ -458,14 +729,17 @@ def _fwd(q, k, v, seed, causal, block_q, block_k, interpret, dropout):
     return out, (q, k, v, seed, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, dropout, res, do):
+def _bwd(causal, block_q, block_k, interpret, dropout, bwd_block_q,
+         bwd_block_k, res, do):
     """Backward by recompute (never materializes the score matrix): blockwise
     Pallas kernels using the flash-attention backward identities, with exact
     probabilities reconstructed from the stored logsumexp (and the dropout
     mask regenerated from the same counters)."""
     q, k, v, seed, out, lse = res
-    dq, dk, dv = _flash_backward(q, k, v, out, lse, do, causal, block_q,
-                                 block_k, _resolve_interpret(interpret),
+    bq, bk = _bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k,
+                         q.shape[-2], k.shape[-2])
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, do, causal, bq,
+                                 bk, _resolve_interpret(interpret),
                                  dropout=dropout, seed=seed)
     return dq, dk, dv, None
 
