@@ -35,8 +35,6 @@ import numpy as np
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-# Above this, the fused backward's Q/dO/dq residency (~10*seq_q*d bytes)
-# no longer fits VMEM comfortably -> two-pass streaming schedule.
 # Fused-backward residency budget: Q/dO/O/dq-out (bf16) + dq scratch (f32)
 # come to ~10*seq_q*d bytes; past this the schedule no longer fits the 16 MB
 # VMEM scope next to the in-flight score tiles -> two-pass streaming.
@@ -661,21 +659,19 @@ def _bwd_blocks(block_q: int, block_k: int, bwd_block_q, bwd_block_k,
     no longer divides seq_k falls back to the (valid) forward block, and an
     EXPLICIT non-dividing override raises — the grid floor-divisions would
     otherwise silently drop the tail keys from dk/dv/dq."""
-    bq = bwd_block_q if bwd_block_q is not None else block_q
-    bk = bwd_block_k if bwd_block_k is not None else min(block_k, 512)
-    for name, blk, seq in (("bwd_block_q", bq, seq_q),
-                           ("bwd_block_k", bk, seq_k)):
+    out = []
+    for name, override, fwd_blk, default, seq in (
+            ("bwd_block_q", bwd_block_q, block_q, block_q, seq_q),
+            ("bwd_block_k", bwd_block_k, block_k, min(block_k, 512), seq_k)):
+        blk = override if override is not None else default
         if seq % min(blk, seq) != 0:
-            if (bwd_block_q if name == "bwd_block_q" else bwd_block_k) \
-                    is not None:
+            if override is not None:
                 raise ValueError(
                     f"flash_attention {name}={blk} does not divide "
                     f"sequence length {seq}")
-    if seq_k % min(bk, seq_k) != 0:
-        bk = block_k  # forward block divides by the public contract
-    if seq_q % min(bq, seq_q) != 0:
-        bq = block_q
-    return bq, bk
+            blk = fwd_blk  # forward block divides by the public contract
+        out.append(blk)
+    return tuple(out)
 
 
 def flash_attention(q, k, v, causal: bool = False,

@@ -21,6 +21,7 @@ class TransformerConfig:
     num_heads: int = 16
     num_layers: int = 12
     use_layernorm: bool = False  # the reference proxy omits LN
+    dropout: float = 0.0  # attention dropout (in-kernel on flash/ring/Ulysses)
 
     @staticmethod
     def tiny(batch_size: int = 8) -> "TransformerConfig":
@@ -37,6 +38,7 @@ def build_transformer(ff: FFModel, cfg: TransformerConfig):
     for layer in range(cfg.num_layers):
         attn = ff.multihead_attention(t, t, t, embed_dim=cfg.hidden,
                                       num_heads=cfg.num_heads,
+                                      dropout=cfg.dropout,
                                       name=f"t{layer}_attn")
         if cfg.use_layernorm:
             attn = ff.layer_norm(ff.add(attn, t), axes=[2],

@@ -172,10 +172,11 @@ def node_options(node: PCGNode, tp: int,
             opts.append(("heads", "R", "R"))
         if space.sequence and in_shapes and q_ok(in_shapes[0]) \
                 and len(node.inputs) == 3 \
-                and len({g for g, _ in node.inputs}) == 1 \
-                and a.get("dropout", 0.0) == 0.0:
-            # self-attention only; the ring kernel has no dropout parameter,
-            # so attention with dropout must keep the einsum core
+                and len({g for g, _ in node.inputs}) == 1:
+            # self-attention only; dropout is fine — ring/Ulysses share the
+            # flash kernel's counter-based in-kernel dropout stream
+            # (kernels/ring_attention.py:49-56, ops/attention.py:113-129),
+            # so the search must not refuse SP to dropout models
             opts.append(("ring", "Q", "Q"))
     elif ot == OperatorType.OP_EMBEDDING:
         if space.parameter and a["num_entries"] % tp == 0:
@@ -245,9 +246,18 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
         if node.guid in sink_guids:
             opts = [o for o in opts if o[2] == "R"] or opts
 
-        def prev_cost(state: str) -> Tuple[float, float, float]:
-            """Sum of producers' best (obj, time, mem) to deliver ``state``."""
+        def prev_cost(state: str
+                      ) -> Tuple[float, float, float, Dict[int, str]]:
+            """Sum of producers' best (obj, time, mem) to deliver ``state``,
+            plus the per-producer OUTPUT state that achieved it — the
+            cheapest delivery may come from a producer in a different state
+            via a reshard (e.g. an R consumer fed by a Q region through one
+            allgather), and backtracking must reconstruct that same choice
+            or the emitted strategy silently diverges from the DP's
+            objective (round-5 bug: every Q region upstream of the R-pinned
+            sink collapsed to all-R at backtrack)."""
             tot_o = tot_t = tot_m = 0.0
+            srcs: Dict[int, str] = {}
             for g, i in node.inputs:
                 p = pcg.nodes[g]
                 if p.op.op_type in (OperatorType.OP_INPUT,
@@ -259,29 +269,43 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 nbytes = int(np.prod(p.out_shapes[i])) * \
                     size_of_datatype(p.op.data_type)
                 best = None
-                for src_state, (po, pt, pm, _bp) in ptab.items():
+                for src_state, (po, pt, pm, _bp, _srcs) in ptab.items():
                     if po >= INF:
+                        continue
+                    if g in srcs and src_state != srcs[g]:
+                        # a producer reached through several edges (e.g. a
+                        # multi-output split) gets ONE state: later edges
+                        # must price the state the first edge committed to,
+                        # or pricing and backtrack diverge again
                         continue
                     # x2: the backward pass runs the transposed resharding
                     xfer = 2 * sim.resharding_cost(nbytes, src_state, state,
                                                    dp, tp)
-                    cand = (po + mix(xfer, 0.0), pt + xfer, pm)
+                    cand = (po + mix(xfer, 0.0), pt + xfer, pm, src_state)
                     if best is None or cand[0] < best[0]:
                         best = cand
                 if best is None:
-                    return (INF, INF, INF)
+                    return (INF, INF, INF, srcs)
                 tot_o += best[0]
                 tot_t += best[1]
                 tot_m += best[2]
-            return (tot_o, tot_t, tot_m)
+                if g in srcs:
+                    # producer obj already counted by the first edge; keep
+                    # only this edge's xfer increment
+                    tot_o -= ptab[srcs[g]][0]
+                    tot_t -= ptab[srcs[g]][1]
+                    tot_m -= ptab[srcs[g]][2]
+                srcs[g] = best[3]
+            return (tot_o, tot_t, tot_m, srcs)
 
-        tab: Dict[str, Tuple[float, float, float, Tuple[str, str]]] = {}
+        tab: Dict[str, Tuple[float, float, float, Tuple[str, str],
+                             Dict[int, str]]] = {}
         for kind, in_state, out_state in opts:
             eff_tp = tp if kind != "none" else 1
             act_tp = tp if (kind == "none" and out_state in ("S", "Q")) else 1
             sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp)
             cm = sim.op_cost(node, in_shapes, sh)
-            base_o, base_t, base_m = prev_cost(in_state)
+            base_o, base_t, base_m, srcs = prev_cost(in_state)
             if base_o >= INF:
                 continue
             node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
@@ -289,15 +313,15 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
             mem = base_m + node_mem
             obj = base_o + mix(cm.total_time(), node_mem)
             if out_state not in tab or obj < tab[out_state][0]:
-                tab[out_state] = (obj, t, mem, (kind, in_state))
+                tab[out_state] = (obj, t, mem, (kind, in_state), srcs)
         if not tab:  # fallback: unsharded
             sh = OpSharding(dp=dp, tp=1, kind="none")
             cm = sim.op_cost(node, in_shapes, sh)
-            base_o, base_t, base_m = prev_cost("R")
+            base_o, base_t, base_m, srcs = prev_cost("R")
             node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
             tab["R"] = (base_o + mix(cm.total_time(), node_mem),
                         base_t + cm.total_time(), base_m + node_mem,
-                        ("none", "R"))
+                        ("none", "R"), srcs)
         table[node.guid] = tab
 
     # backtrack: choose best final state, then walk back per node
@@ -309,7 +333,8 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
         if node.guid not in chosen:
             chosen[node.guid] = min(tab, key=lambda s: tab[s][0])
         st = chosen[node.guid]
-        kind, in_state = tab[st][3]
+        kind, _in_state = tab[st][3]
+        srcs = tab[st][4]
         eff_tp = tp if kind != "none" else 1
         act_tp = tp if (kind == "none" and st in ("S", "Q")) else 1
         assignment[node.guid] = OpSharding(dp=dp, tp=eff_tp, kind=kind,
@@ -321,7 +346,9 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                                     OperatorType.OP_WEIGHT) \
                     and g not in chosen:
                 ptab = table[g]
-                chosen[g] = in_state if in_state in ptab else \
+                # the producer state prev_cost actually priced (may differ
+                # from the op's declared in_state when a reshard was cheaper)
+                chosen[g] = srcs[g] if srcs.get(g) in ptab else \
                     min(ptab, key=lambda s: ptab[s][0])
     # total time: recompute via the simulator so resharding edges and shared
     # subgraphs are counted exactly once (event-driven when the native
