@@ -111,8 +111,7 @@ class MultiHeadAttentionOp(Op):
         causal = self.attrs.get("causal", False)
         seq_axis = self.attrs.get("sequence_parallel_axis")
         dropout = self.attrs.get("dropout", 0.0)
-        live_dropout = float(dropout) if (dropout and ctx.training
-                                          and ctx.rng is not None) else 0.0
+        live_dropout = _resolve_live_dropout(dropout, ctx)
         seed = _dropout_seed(ctx.rng) if live_dropout else None
         if seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
             if self.attrs.get("sequence_parallel_mode") == "alltoall":
@@ -168,6 +167,25 @@ def _dropout_seed(rng):
     import jax.numpy as jnp
 
     return jax.random.bits(rng, (), jnp.uint32)
+
+
+def _resolve_live_dropout(dropout, ctx) -> float:
+    """Effective dropout rate for this forward. A training context that
+    requests dropout but carries no rng would otherwise SILENTLY train
+    without dropout on every kernel path (the kernel entry points raise,
+    the op layer used to swallow it — ADVICE r4): surface it loudly."""
+    if not dropout or not ctx.training:
+        return 0.0
+    if ctx.rng is None:
+        import warnings
+
+        warnings.warn(
+            f"attention dropout={dropout} requested with training=True but "
+            f"the step context has no rng — training WITHOUT dropout. "
+            f"Thread an rng through the executor (fit/make_train_step do "
+            f"this automatically).", stacklevel=3)
+        return 0.0
+    return float(dropout)
 
 
 def _flash_blocks(seq_q: int, seq_k: int):
@@ -237,8 +255,7 @@ class SDPAOp(Op):
         # flash kernel has no mask/scale parameters — only take it when the
         # request needs neither (dropout IS supported in-kernel)
         dropout = self.attrs.get("dropout", 0.0)
-        live_dropout = float(dropout) if (dropout and ctx.training
-                                          and ctx.rng is not None) else 0.0
+        live_dropout = _resolve_live_dropout(dropout, ctx)
         if mask is None and self.attrs.get("scale") is None \
                 and _should_use_flash(
                     self.attrs.get("use_flash", "auto"), q, k, causal) \

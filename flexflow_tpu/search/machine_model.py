@@ -120,7 +120,16 @@ class TPUMachineModel:
         # the DCN factor is the process count (hosts == slices here)
         hosts = num_hosts or \
             (jax.process_count() if n == len(devs) else 1)
-        hosts = hosts if n % max(hosts, 1) == 0 else 1
+        if n % max(hosts, 1) != 0:
+            # silent reset would hand an explicit multi-host caller a
+            # single-host cost model with no signal (ADVICE r4)
+            import warnings
+
+            warnings.warn(
+                f"TPUMachineModel.detect: num_hosts={hosts} does not divide "
+                f"num_chips={n}; falling back to a single-host model",
+                stacklevel=2)
+            hosts = 1
         kind = devs[0].device_kind.lower()
         for gen in TPU_GENERATIONS:
             if gen in kind.replace(" ", "").replace("lite", "e"):

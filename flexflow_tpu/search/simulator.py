@@ -155,6 +155,15 @@ class Simulator:
         # (reference: optimizer_kernel.cu adam_update_task). Set 0 to price
         # bare SGD (in-place w -= lr*g streams ~3x).
         self.update_bytes_factor = 7.0
+        # optimizer state words per weight word resident all step (Adam m+v
+        # = 2; bare SGD = 0); weights count x(1 + opt_state_words) in the
+        # peak-memory model
+        self.opt_state_words = 2
+        # bytes per saved-activation element under mixed precision (set by
+        # calibrate_from_pcg from its compute_dtype; None = the op dtype) —
+        # XLA saves residuals in the COMPUTE dtype, so bf16 halves the
+        # activation term of the peak-memory model
+        self.activation_el: Optional[int] = None
         self._dispatch_overhead: Optional[float] = None
         # which mesh axis carries the machine's DCN factor for the candidate
         # being costed (reference: intra- vs inter-node pricing in
@@ -170,6 +179,29 @@ class Simulator:
         latency/bandwidth for the cross-host phase."""
         self.dp_dcn = max(dp_dcn, 1)
         self.tp_dcn = max(tp_dcn, 1)
+
+    def scaled_bytes(self, nbytes: int, node: PCGNode) -> int:
+        """Re-price ``nbytes`` (computed at the op's declared dtype) into
+        the COMPUTE dtype: under mixed precision both the saved residuals
+        and the weight grads live in ``activation_el``-byte elements."""
+        if self.activation_el is None:
+            return nbytes
+        el = size_of_datatype(node.op.data_type)
+        return int(nbytes * self.activation_el // max(el, 1))
+
+    def act_bytes(self, node: PCGNode, cm: "CostMetrics") -> int:
+        """This node's saved-activation bytes in the compute dtype."""
+        return self.scaled_bytes(cm.outputs_memory, node)
+
+    def node_resident_bytes(self, node: PCGNode, cm: "CostMetrics") -> int:
+        """Per-node resident memory under the liveness-aware model — the
+        SAME formula ``simulate``'s peak sums (saved activation in the
+        compute dtype + f32 master weights with optimizer moments + the
+        weight grad in the compute dtype), shared so the memory-λ DP and
+        the feasibility check price one model."""
+        return (self.act_bytes(node, cm)
+                + cm.weights_memory * (1 + self.opt_state_words)
+                + self.scaled_bytes(cm.weights_memory, node))
 
     def _nic_sharers(self, group_ici: int) -> int:
         """Concurrent distinct collective groups per host sharing the NIC:
@@ -313,7 +345,9 @@ class Simulator:
         total_sync = 0.0
         total_bwd = 0.0
         total_update = 0.0
-        mem = 0
+        resident_w = 0
+        resident_act = 0
+        transient = 0
         states = states or {}
         el_cache: Dict[int, CostMetrics] = {}
         for node in pcg.compute_nodes():
@@ -326,8 +360,25 @@ class Simulator:
             total_comm += cm.comm_time
             total_sync += cm.sync_time
             total_update += cm.update_time
-            # activation memory: outputs + grads (x2), weights + opt state (x3)
-            mem += cm.outputs_memory * 2 + cm.weights_memory * 4
+            # Per-chip peak memory, liveness-aware (validated against XLA's
+            # Compiled.memory_analysis peak, which is ~ arguments + temps
+            # with donated outputs aliased):
+            #  - weights: master param + optimizer moments resident all step
+            #    (f32 p/m/v under Adam = x(1 + opt_state_words)), plus every
+            #    weight GRAD in the compute dtype — XLA materializes all of
+            #    them before the optimizer-update phase consumes them
+            #  - activations: every saved-for-backward output is live at
+            #    once when backward starts, in the COMPUTE dtype (bf16
+            #    halves it under mixed precision) — x1, not x2: activation
+            #    grads are freed as backward consumes them
+            #  - transient: the widest node's working set (its output grad +
+            #    recomputed output + weight grad)
+            act = self.act_bytes(node, cm)
+            wgrad = self.scaled_bytes(cm.weights_memory, node)
+            resident_act += act
+            resident_w += cm.weights_memory * (1 + self.opt_state_words) \
+                + wgrad
+            transient = max(transient, 2 * act + wgrad)
             # resharding on input edges (against the state the op consumes)
             my_state = op_in_state(sh, states.get(node.guid, "R"))
             for g, i in node.inputs:
@@ -344,7 +395,7 @@ class Simulator:
         if self.overlap:
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
         return (total_compute + total_comm + total_sync + total_update,
-                mem)
+                resident_w + resident_act + transient)
 
     def simulate_event_driven(self, pcg: PCG,
                               assignment: Dict[int, OpSharding],
@@ -449,7 +500,14 @@ class Simulator:
         device-calibrated times (reference: Simulator::measure_operator_cost
         ground truth feeding graph_cost, simulator.cc:489). Returns the number
         of distinct ops measured. Cheap on repetitive graphs: BERT-Large has
-        ~7 distinct op shapes across 24 layers."""
+        ~7 distinct op shapes across 24 layers.
+
+        Also records the compute dtype's element size for the peak-memory
+        model (saved activations live in the compute dtype)."""
+        if compute_dtype is not None:
+            import jax.numpy as jnp
+
+            self.activation_el = jnp.dtype(compute_dtype).itemsize
         measured = 0
         for node in pcg.compute_nodes():
             in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]

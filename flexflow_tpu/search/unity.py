@@ -308,7 +308,10 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
             base_o, base_t, base_m, srcs = prev_cost(in_state)
             if base_o >= INF:
                 continue
-            node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
+            # liveness-aware per-node resident memory — the same formula
+            # Simulator.simulate's peak sums, so the memory-λ DP and the
+            # feasibility check price one model
+            node_mem = sim.node_resident_bytes(node, cm)
             t = base_t + cm.total_time()
             mem = base_m + node_mem
             obj = base_o + mix(cm.total_time(), node_mem)
@@ -318,7 +321,10 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
             sh = OpSharding(dp=dp, tp=1, kind="none")
             cm = sim.op_cost(node, in_shapes, sh)
             base_o, base_t, base_m, srcs = prev_cost("R")
-            node_mem = cm.outputs_memory * 2 + cm.weights_memory * 4
+            # liveness-aware per-node resident memory — the same formula
+            # Simulator.simulate's peak sums, so the memory-λ DP and the
+            # feasibility check price one model
+            node_mem = sim.node_resident_bytes(node, cm)
             tab["R"] = (base_o + mix(cm.total_time(), node_mem),
                         base_t + cm.total_time(), base_m + node_mem,
                         ("none", "R"), srcs)
@@ -503,9 +509,11 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
                            first_host(s) != first_host(s + 1)) else "ici"
         for g, i in specs[s].outputs:
             node = pcg.nodes[g]
-            nbytes = int(np.prod(node.out_shapes[i])) * \
-                size_of_datatype(node.op.data_type) \
-                // (max(dp, 1) * max(n_micro, 1))
+            # at least 1 byte: integer flooring to 0 would price the hop at
+            # pure latency and make tiny cross-stage tensors free (ADVICE r4)
+            nbytes = max(int(np.prod(node.out_shapes[i])) *
+                         size_of_datatype(node.op.data_type)
+                         // (max(dp, 1) * max(n_micro, 1)), 1)
             bnd_micro[s] += machine.p2p_time(nbytes, medium)
 
     m_f = [t / max(n_micro, 1) for t in stage_fwd]
