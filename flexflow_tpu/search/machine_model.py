@@ -30,6 +30,18 @@ TPU_GENERATIONS = {
 }
 
 
+def detect_generation(device_kind: str):
+    """Normalize a jax ``device_kind`` string to a TPU_GENERATIONS key
+    ('TPU v5 lite' -> 'v5e'), or None when unrecognized. The ONE place the
+    kind-string matching lives — TPUMachineModel.detect and the flash
+    crossover table (ops/attention.FLASH_TUNING) both key off it."""
+    kind = device_kind.lower().replace(" ", "").replace("lite", "e")
+    for gen in TPU_GENERATIONS:
+        if gen in kind:
+            return gen
+    return None
+
+
 @dataclasses.dataclass
 class TPUMachineModel:
     """Analog of MachineModel v0/v1 with TPU parameters."""
@@ -55,6 +67,11 @@ class TPUMachineModel:
     matmul_efficiency: float = 0.6
     # fraction of HBM bandwidth achieved by fused elementwise ops
     hbm_efficiency: float = 0.8
+    # fraction achieved by the 7-stream optimizer update (4 concurrent
+    # reads + 3 writes): measured on v5e — a fused Adam moves 705 MB in
+    # 1.63 ms (~435 GB/s) and the BERT-Large profile shows ~495 GB/s, far
+    # below the single-stream 0.8. Overridable per machine via machine.cfg.
+    update_hbm_efficiency: float = 0.55
 
     @staticmethod
     def from_generation(gen: str, num_chips: int = 1,
@@ -88,7 +105,8 @@ class TPUMachineModel:
                                             num_chips, num_hosts=num_hosts)
         for field in ("peak_flops", "hbm_bandwidth", "ici_bandwidth",
                       "dcn_bandwidth", "ici_latency", "dcn_latency",
-                      "matmul_efficiency", "hbm_efficiency"):
+                      "matmul_efficiency", "hbm_efficiency",
+                      "update_hbm_efficiency"):
             if field in kv:
                 setattr(m, field, float(kv[field]))
         if "hbm_capacity" in kv:
@@ -130,14 +148,8 @@ class TPUMachineModel:
                 f"num_chips={n}; falling back to a single-host model",
                 stacklevel=2)
             hosts = 1
-        kind = devs[0].device_kind.lower()
-        for gen in TPU_GENERATIONS:
-            if gen in kind.replace(" ", "").replace("lite", "e"):
-                return TPUMachineModel.from_generation(gen, n,
-                                                       num_hosts=hosts)
-        if "v5 lite" in kind or "v5lite" in kind:
-            return TPUMachineModel.from_generation("v5e", n, num_hosts=hosts)
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        gen = detect_generation(devs[0].device_kind) or \
+            os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
         return TPUMachineModel.from_generation(gen, n, num_hosts=hosts)
 
     @property

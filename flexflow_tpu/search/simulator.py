@@ -90,6 +90,8 @@ def op_in_state(sh: Optional["OpSharding"], out_state: str) -> str:
         return "S"
     if sh.kind == "ring":
         return "Q"
+    if sh.kind == "spatial":
+        return "H"
     if sh.kind in ("heads", "table", "expert"):
         return "R"
     return out_state
@@ -266,6 +268,18 @@ class Simulator:
             comm = 2 * m.hier_alltoall_time(
                 in_bytes // deg, tp_ici, tp_dcn,
                 nic_sharers=self._nic_sharers(tp_ici))
+        elif sh.kind == "spatial" and sh.tp > 1:
+            # spatial (height) partition: halo exchange of (kernel_h - 1)
+            # boundary input rows with ring neighbors per step (reference:
+            # the ghost regions of create_mapping_xfers<Conv2D/Pool2D>,
+            # substitution.cc:1797-1800; XLA SPMD materializes them as
+            # collective-permutes)
+            kh = int(op.attrs.get("kernel_h", 1))
+            in0 = in_shapes[0] if in_shapes else None
+            if in0 is not None and len(in0) == 4 and in0[2] > 0 and kh > 1:
+                row_bytes = int(np.prod(in0)) * el // in0[2]
+                comm = m.p2p_time((kh - 1) * row_bytes // max(sh.dp, 1),
+                                  "ici")
 
         # every forward activation collective has a mirror in backward
         # (Megatron's f/g conjugate operators; ring attention re-rotates k/v
@@ -274,12 +288,13 @@ class Simulator:
         comm *= 2.0
 
         # gradient sync: weights replicated over dp -> allreduce over dp;
-        # ring attention and pass-through SP states replicate weights over tp
-        # too, so their grads reduce over dp*tp
+        # ring attention, spatial partitioning and pass-through SP states
+        # replicate weights over tp too, so their grads reduce over dp*tp
         sync = 0.0
-        sync_n = sh.dp * (sh.tp if sh.kind == "ring" else sh.act_tp)
+        sync_n = sh.dp * (sh.tp if sh.kind in ("ring", "spatial")
+                          else sh.act_tp)
         if w_bytes and sync_n > 1:
-            spans_tp = sh.kind == "ring" or sh.act_tp > 1
+            spans_tp = sh.kind in ("ring", "spatial") or sh.act_tp > 1
             sync_dcn = (self.dp_dcn if sh.dp % self.dp_dcn == 0 else 1) * \
                 (tp_dcn if spans_tp else 1)
             if sync_n % sync_dcn != 0:
@@ -293,10 +308,13 @@ class Simulator:
         # (reference prices update explicitly via optimizer kernels,
         # src/runtime/optimizer_kernel.cu) — at BERT-Large scale Adam moves
         # ~7x the weight bytes and is a double-digit % of the step
+        # the 7-stream update runs at the machine's MEASURED multi-stream
+        # HBM fraction, not the single-stream hbm_efficiency (2.3x DLRM
+        # under-pricing otherwise — see TPUMachineModel.update_hbm_efficiency)
         update = 0.0
         if w_bytes:
             update = (self.update_bytes_factor * w_bytes / w_div
-                      / (m.hbm_bandwidth * m.hbm_efficiency))
+                      / (m.hbm_bandwidth * m.update_hbm_efficiency))
 
         return CostMetrics(
             forward_time=fwd, backward_time=bwd, sync_time=sync,
@@ -312,10 +330,12 @@ class Simulator:
 
         States: 'R' = sharded over data only (replicated over model axis),
         'S' = additionally sharded over the model (hidden) axis, 'Q' =
-        additionally sharded over the sequence dim. These transitions are the
-        Repartition/Combine/AllToAll parallel ops of the reference
-        (src/parallel_ops/): R->{S,Q} is a local slice (free), {S,Q}->R is an
-        all-gather over tp, S<->Q is an all-to-all over tp.
+        additionally sharded over the sequence dim, 'H' = over the spatial
+        height dim (NCHW CNNs). These transitions are the Repartition/
+        Combine/AllToAll parallel ops of the reference (src/parallel_ops/):
+        R->{S,Q,H} is a local slice (free), {S,Q,H}->R is an all-gather
+        over tp, and any sharded<->differently-sharded pair is an
+        all-to-all over tp.
         """
         if src_state == dst_state or tp <= 1:
             return 0.0

@@ -1,0 +1,258 @@
+"""keras_exp DAG walker exercised WITHOUT tensorflow (VERDICT r4 item 9:
+the tf import gate made the walker unverifiable dead code in this image).
+
+A minimal fake-tf module provides exactly the surface the walker touches
+(keras.layers classes, Model.inputs/outputs/layers, layer._inbound_nodes
+with input/output tensors — mirroring the real trace of
+/root/reference/python/flexflow/keras_exp/models/model.py), so the
+conversion logic itself runs and is checked against the built FFModel
+graph."""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.ffconst import OperatorType
+
+
+class _Tensor:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class _Node:
+    def __init__(self, inputs, outputs):
+        self.input_tensors = inputs
+        self.output_tensors = outputs
+
+
+class _LayerBase:
+    def __init__(self, name):
+        self.name = name
+        self._inbound_nodes = []
+
+    def __call__(self, *inputs):
+        ins = list(inputs)
+        out = _Tensor(self.out_shape([t.shape for t in ins]))
+        self._inbound_nodes.append(_Node(ins, [out]))
+        return out
+
+
+def _fake_tf():
+    """A module shaped like tensorflow as far as keras_exp walks it."""
+    tf = types.ModuleType("tensorflow")
+    keras = types.ModuleType("tensorflow.keras")
+    layers = types.ModuleType("tensorflow.keras.layers")
+
+    def relu(x):
+        return x
+    relu.__name__ = "relu"
+
+    def softmax(x):
+        return x
+    softmax.__name__ = "softmax"
+
+    class InputLayer(_LayerBase):
+        pass
+
+    class Dense(_LayerBase):
+        def __init__(self, units, activation=None, use_bias=True,
+                     name="dense"):
+            super().__init__(name)
+            self.units = units
+            self.activation = activation
+            self.use_bias = use_bias
+
+        def out_shape(self, shapes):
+            return shapes[0][:-1] + (self.units,)
+
+    class Add(_LayerBase):
+        def out_shape(self, shapes):
+            return shapes[0]
+
+    class Concatenate(_LayerBase):
+        def __init__(self, axis=-1, name="concat"):
+            super().__init__(name)
+            self.axis = axis
+
+        def out_shape(self, shapes):
+            out = list(shapes[0])
+            out[self.axis] = sum(s[self.axis] for s in shapes)
+            return tuple(out)
+
+    class Activation(_LayerBase):
+        def __init__(self, activation, name="act"):
+            super().__init__(name)
+            self.activation = activation
+
+        def out_shape(self, shapes):
+            return shapes[0]
+
+    class Dropout(_LayerBase):
+        def __init__(self, rate, name="drop"):
+            super().__init__(name)
+            self.rate = rate
+
+        def out_shape(self, shapes):
+            return shapes[0]
+
+    # classes the walker isinstance-checks but this test does not build
+    class Conv2D(_LayerBase):
+        pass
+
+    class MaxPooling2D(_LayerBase):
+        pass
+
+    class AveragePooling2D(_LayerBase):
+        pass
+
+    class Flatten(_LayerBase):
+        pass
+
+    class BatchNormalization(_LayerBase):
+        pass
+
+    for cls in (InputLayer, Dense, Add, Concatenate, Activation, Dropout,
+                Conv2D, MaxPooling2D, AveragePooling2D, Flatten,
+                BatchNormalization):
+        setattr(layers, cls.__name__, cls)
+    keras.layers = layers
+    keras.activations = types.SimpleNamespace(relu=relu, softmax=softmax)
+    tf.keras = keras
+
+    class Model:
+        def __init__(self, inputs, outputs, layer_list):
+            self.inputs = inputs
+            self.outputs = outputs
+            self.layers = layer_list
+
+    keras.Model = Model
+    return tf, relu, softmax
+
+
+def test_keras_exp_traces_fake_tf_dag(monkeypatch):
+    tf, relu, softmax = _fake_tf()
+    monkeypatch.setitem(sys.modules, "tensorflow", tf)
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+
+    L = tf.keras.layers
+    x = _Tensor((8, 64))
+    d1 = L.Dense(32, activation=relu, name="fc1")
+    b1 = L.Dense(16, name="branch_a")
+    b2 = L.Dense(16, name="branch_b")
+    add = L.Add(name="merge")
+    drop = L.Dropout(0.1, name="drop")
+    head = L.Dense(10, name="head")
+    act = L.Activation(softmax, name="probs")
+
+    h = d1(x)
+    a = b1(h)
+    b = b2(h)
+    m = add(a, b)
+    p = drop(m)
+    o = act(head(p))
+    model = tf.keras.Model([x], [o],
+                           [d1, b1, b2, add, drop, head, act])
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    ff_in = ff.create_tensor((8, 64), name="x")
+    outs = KerasExpModel(model).apply(ff, [ff_in])
+    assert len(outs) == 1 and outs[0].dims == (8, 10)
+
+    ops = [n.op.op_type for n in ff.create_pcg().compute_nodes()]
+    assert ops.count(OperatorType.OP_LINEAR) == 4
+    assert OperatorType.OP_EW_ADD in ops
+    assert OperatorType.OP_DROPOUT in ops
+    assert OperatorType.OP_SOFTMAX in ops
+
+    # and the traced graph actually trains end-to-end
+    ff.compile(optimizer=SGDOptimizer(None, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 64)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(8, 1)).astype(np.int32)
+    ff.fit(x=[xs], y=ys, epochs=1)
+
+
+def test_keras_exp_import_gate_message():
+    """Without tensorflow the gate raises the documented ImportError (the
+    contract the ONNX frontend also follows)."""
+    from flexflow_tpu.frontends.keras_exp import _require_tf
+
+    try:
+        import tensorflow  # noqa: F401
+        pytest.skip("real tensorflow present")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="keras_exp"):
+        _require_tf()
+
+
+tf_real = pytest.importorskip("tensorflow", reason="tensorflow not bundled")
+
+
+def test_keras_exp_traces_real_tf_mlp():
+    """Trace a REAL functional tf.keras model (branches + merge + softmax
+    head) — the reference's keras_exp walks exactly this DAG
+    (/root/reference/python/flexflow/keras_exp/models/model.py)."""
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+
+    tf = tf_real
+    inp = tf.keras.Input(shape=(64,), batch_size=8)
+    h = tf.keras.layers.Dense(32, activation="relu", name="fc1")(inp)
+    a = tf.keras.layers.Dense(16, name="branch_a")(h)
+    b = tf.keras.layers.Dense(16, name="branch_b")(h)
+    m = tf.keras.layers.Add(name="merge")([a, b])
+    o = tf.keras.layers.Dense(10, activation="softmax", name="head")(m)
+    model = tf.keras.Model(inp, o)
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    ff_in = ff.create_tensor((8, 64), name="x")
+    outs = KerasExpModel(model).apply(ff, [ff_in])
+    assert len(outs) == 1 and outs[0].dims == (8, 10)
+    ops = [n.op.op_type for n in ff.create_pcg().compute_nodes()]
+    assert ops.count(OperatorType.OP_LINEAR) == 4
+    assert OperatorType.OP_EW_ADD in ops
+    assert OperatorType.OP_SOFTMAX in ops
+
+    ff.compile(optimizer=SGDOptimizer(None, lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 64)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(8, 1)).astype(np.int32)
+    ff.fit(x=[xs], y=ys, epochs=1)
+
+
+def test_keras_exp_traces_real_tf_cnn():
+    """Conv/pool/flatten path on a channels_first real tf.keras model (the
+    layout FFModel's conv2d uses, reference NCHW)."""
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+
+    tf = tf_real
+    inp = tf.keras.Input(shape=(3, 16, 16), batch_size=4)
+    t = tf.keras.layers.Conv2D(8, (3, 3), padding="same",
+                               data_format="channels_first",
+                               activation="relu", name="c1")(inp)
+    t = tf.keras.layers.MaxPooling2D((2, 2), (2, 2),
+                                     data_format="channels_first",
+                                     name="p1")(t)
+    t = tf.keras.layers.Flatten(name="flat")(t)
+    o = tf.keras.layers.Dense(10, activation="softmax", name="head")(t)
+    model = tf.keras.Model(inp, o)
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    ff_in = ff.create_tensor((4, 3, 16, 16), name="img")
+    outs = KerasExpModel(model).apply(ff, [ff_in])
+    assert outs[0].dims == (4, 10)
+    ops = [n.op.op_type for n in ff.create_pcg().compute_nodes()]
+    assert OperatorType.OP_CONV2D in ops
+    assert OperatorType.OP_POOL2D in ops
+    assert OperatorType.OP_FLAT in ops
