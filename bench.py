@@ -147,6 +147,7 @@ def main():
         result.update(long_context_leg(peak))
         result.update(dlrm_leg())
         result.update(alexnet_leg())
+        result.update(memory_pressure_search_leg())
     print(json.dumps(result))
 
 
@@ -361,6 +362,60 @@ def alexnet_leg() -> dict:
         out.update(_sim_vs_measured(ff, dt, "alexnet"))
     except Exception as e:
         out["alexnet_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def memory_pressure_search_leg() -> dict:
+    """The search's reason-for-existence on its flagship model (VERDICT r4
+    item 6; reference: memory-aware search, graph.cc:2060-2133): BERT-Large
+    at batch 512 needs 19.4 GiB/chip under pure DP-8 — infeasible on v5e's
+    16 GiB by the GROUNDED memory model — and the memory-aware search must
+    find a feasible strategy. Activations dominate and are sharded under
+    every (dp, tp), so the real escape is GPipe microbatching (live
+    activations / n_micro); the search discovers that itself."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+    from flexflow_tpu.search.unity import unity_search
+
+    out = {}
+    try:
+        config = FFConfig()
+        config.batch_size = 512
+        config.perform_memory_search = True
+        ff = FFModel(config)
+        cfg = BertConfig(batch_size=512, seq_len=512, hidden=1024,
+                         num_heads=16, num_layers=24, intermediate=4096)
+        build_bert(ff, cfg)
+        pcg = ff.create_pcg()
+        machine = TPUMachineModel.from_generation("v5e", 8)
+        sim = Simulator(machine)
+        sim.activation_el = 2  # bf16 activations (the validated model)
+        from flexflow_tpu.search.unity import simulate_best
+
+        dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+        _, mem_dp = sim.simulate(pcg, dp8, {})
+        # time the DP baseline with the SAME event-driven engine the search
+        # uses — mixing engines biases the ratio (VERDICT r4 weak #5)
+        t_dp = simulate_best(sim, pcg, dp8, {})
+        res = unity_search(pcg.copy(), config, 8, machine=machine,
+                           return_result=True, insert_ir_nodes=False,
+                           sim=sim)
+        out["memsearch_dp8_mem_gib"] = round(mem_dp / 2 ** 30, 2)
+        out["memsearch_dp8_feasible"] = bool(
+            mem_dp <= machine.hbm_capacity)
+        out["memsearch_mem_gib"] = round(res.sim_memory / 2 ** 30, 2)
+        out["memsearch_feasible"] = bool(
+            res.sim_memory <= machine.hbm_capacity)
+        out["memsearch_pipeline"] = list(res.strategy.pipeline) \
+            if getattr(res.strategy, "pipeline", None) else None
+        out["memsearch_mesh"] = list(res.mesh_shape)
+        # >1 means the searched strategy is also FASTER than the (OOM)
+        # DP plan would have been; <1 records the price of feasibility
+        out["memsearch_vs_dp_time"] = round(t_dp / res.sim_time, 3)
+    except Exception as e:
+        out["memsearch_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

@@ -202,14 +202,10 @@ def _resolve_live_dropout(dropout, ctx) -> float:
 # at b1 h16 s4096 d64 bf16 over (bq, bk) in {128,256,512}x{256,512,1024}
 # and vs mha_core at seq 640, then update the row.
 FLASH_TUNING = {
-    "v5e": {"measured": True, "block_q_cap": 512, "block_k_cap": 1024,
-            "min_block": 256},
-    "v4": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
-           "min_block": 256},
-    "v5p": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
-            "min_block": 256},
-    "v6e": {"measured": False, "block_q_cap": 512, "block_k_cap": 1024,
-            "min_block": 256},
+    # v5e is the only MEASURED row; _flash_tuning() falls back to it for
+    # every other generation (v4/v5p/v6e: add a measured row here after
+    # running the recipe above on that chip)
+    "v5e": {"block_q_cap": 512, "block_k_cap": 1024, "min_block": 256},
 }
 _tuning_cache = {}
 

@@ -157,6 +157,14 @@ class Simulator:
         # (reference: optimizer_kernel.cu adam_update_task). Set 0 to price
         # bare SGD (in-place w -= lr*g streams ~3x).
         self.update_bytes_factor = 7.0
+        # fixed per-op scheduling overhead (s): the reference's measured
+        # task costs inherently include the Legion task-launch overhead
+        # (Unity's simulator times whole task bodies, simulator.cc:489);
+        # XLA's analog is sub-microsecond per-HLO scheduling. This term is
+        # what makes op-count-reducing rewrites (activation fusions, the
+        # TASO collection's shrinking rules) properly valued — without it
+        # merging two elementwise ops is cost-neutral in a pure roofline.
+        self.op_overhead = 5e-7
         # optimizer state words per weight word resident all step (Adam m+v
         # = 2; bare SGD = 0); weights count x(1 + opt_state_words) in the
         # peak-memory model
@@ -239,7 +247,7 @@ class Simulator:
         mem_time = shard_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
         key = self._op_key(node, in_shapes)
         cal = self._key_calibration.get(key, self.calibration)
-        fwd = max(compute, mem_time) * cal
+        fwd = max(compute, mem_time) * cal + self.op_overhead
         # backward: measured per-key ratio when calibrated on device
         # (calibrate_from_pcg times value_and_grad standalone); analytical
         # 2x/1x heuristic otherwise
@@ -536,8 +544,13 @@ class Simulator:
                 continue
             if measured >= max_ops:
                 break
+            # calibrate against the ROOFLINE term alone: op_cost predicts
+            # roofline*cal + op_overhead, so the ratio must be computed on
+            # (measured - overhead)/roofline or calibrated predictions
+            # would not reproduce the measurement for small ops
             analytical = self.op_cost(node, in_shapes,
-                                      OpSharding()).forward_time
+                                      OpSharding()).forward_time \
+                - self.op_overhead
             if analytical <= 0:
                 continue
             try:
@@ -546,7 +559,8 @@ class Simulator:
             except Exception:
                 continue  # op not measurable standalone (e.g. host-side)
             if t > 0:
-                self._key_calibration[key] = t / analytical
+                self._key_calibration[key] = \
+                    max(t - self.op_overhead, 0.1 * t) / analytical
                 measured += 1
                 # measured backward: time fwd+bwd together (what training
                 # compiles) and store the bwd/fwd ratio, replacing the

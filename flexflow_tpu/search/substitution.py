@@ -39,9 +39,14 @@ _NAME_TO_OP = {
     "OP_TRANSPOSE": OperatorType.OP_TRANSPOSE,
     "OP_SOFTMAX": OperatorType.OP_SOFTMAX,
     "OP_REPARTITION": OperatorType.OP_REPARTITION,
+    # the TASO collection's names for the parallel ops
+    # (substitution_loader.h's table): OP_PARTITION == Repartition,
+    # OP_REDUCE == Reduction
+    "OP_PARTITION": OperatorType.OP_REPARTITION,
     "OP_COMBINE": OperatorType.OP_COMBINE,
     "OP_REPLICATE": OperatorType.OP_REPLICATE,
     "OP_REDUCTION": OperatorType.OP_REDUCTION,
+    "OP_REDUCE": OperatorType.OP_REDUCTION,
     "OP_MULTIHEAD_ATTENTION": OperatorType.OP_MULTIHEAD_ATTENTION,
 }
 
@@ -97,7 +102,8 @@ class GraphXfer:
         for n in nodes:
             by_type.setdefault(n.op.op_type, []).append(n)
 
-        def backtrack(i: int, mapping: Dict[int, int]):
+        def backtrack(i: int, mapping: Dict[int, int],
+                      open_bind: Dict[int, tuple]):
             if i == len(self.src):
                 matches.append(dict(mapping))
                 return
@@ -106,20 +112,36 @@ class GraphXfer:
                 if cand.guid in mapping.values():
                     continue
                 ok = True
+                bound_here = []
                 for slot, pin in enumerate(px.inputs):
                     if pin >= 0:
                         if slot >= len(cand.inputs) or \
                                 cand.inputs[slot][0] != mapping.get(pin):
                             ok = False
                             break
+                    elif slot < len(cand.inputs):
+                        # open slots with the same id are the SAME external
+                        # tensor (TASO rules share weights/inputs this way)
+                        # — every occurrence must bind to one producer
+                        prod = cand.inputs[slot]
+                        if pin in open_bind:
+                            if open_bind[pin] != prod:
+                                ok = False
+                                break
+                        else:
+                            open_bind[pin] = prod
+                            bound_here.append(pin)
                 if ok and not px.constraint_ok(cand.op.attrs):
                     ok = False
                 if ok:
                     mapping[i] = cand.guid
-                    backtrack(i + 1, mapping)
+                    backtrack(i + 1, mapping, open_bind)
                     del mapping[i]
+                for pin in bound_here:
+                    del open_bind[pin]
+                bound_here.clear()
 
-        backtrack(0, {})
+        backtrack(0, {}, {})
         # interior nodes (consumed inside the pattern) must have no external
         # consumers
         out = []
@@ -218,8 +240,8 @@ def load_substitution_json(path: str) -> List[GraphXfer]:
     """Parse a TASO-style rule collection (reference:
     substitution_loader.cc `from_json`; format: {"rule": [{"name", "srcOp":
     [{"type", "input": [{"opId","tsId"}], "para": [...]}], "dstOp": [...]}]}).
-    Unknown op types skip the rule (the reference does the same for ops it
-    can't map)."""
+    Unknown op types or parameter values skip the rule (the reference does
+    the same for ops it can't map)."""
     with open(path) as f:
         data = json.load(f)
     rules = data.get("rule", data.get("rules", []))
@@ -227,7 +249,7 @@ def load_substitution_json(path: str) -> List[GraphXfer]:
     for rule in rules:
         try:
             src = _parse_ops(rule.get("srcOp", []))
-            dst = _parse_ops(rule.get("dstOp", []))
+            dst = _parse_ops(rule.get("dstOp", []), dst=True)
         except KeyError:
             continue
         if src:
@@ -236,7 +258,21 @@ def load_substitution_json(path: str) -> List[GraphXfer]:
     return xfers
 
 
-def _parse_ops(ops_json) -> List[OpX]:
+# TASO's ActiMode encoding in the rule collection (values observed in
+# graph_subst_3_v2.json: 0 and 2) -> our ActiMode. An unmapped value makes
+# the RULE unparseable — silently dropping the constraint would let an
+# activation-fusing rule delete a relu without fusing it (r5 review).
+_TASO_ACTI = {0: None, 1: "AC_MODE_SIGMOID", 2: "AC_MODE_RELU",
+              3: "AC_MODE_TANH"}
+
+
+def _parse_ops(ops_json, dst: bool = False) -> List[OpX]:
+    """``dst=False``: parameters become match CONSTRAINTS on the src
+    pattern. ``dst=True``: they become attr OVERRIDES on the new ops —
+    apply() reads only attr_overrides, so dst-side attributes fed into
+    constraints would be silently ignored (r5 review)."""
+    from ..ffconst import ActiMode
+
     out = []
     for op in ops_json:
         tname = op.get("type")
@@ -244,13 +280,42 @@ def _parse_ops(ops_json) -> List[OpX]:
             raise KeyError(tname)
         inputs = []
         for inp in op.get("input", []):
-            op_id = inp.get("opId", -1)
-            inputs.append(op_id if op_id >= 0 else -1 - len(inputs))
+            # negative opIds are the rule's GLOBAL open-input slots: the
+            # same id appearing in several ops means the same external
+            # tensor (e.g. a shared weight), so keep them verbatim —
+            # renumbering per op (pre-round-5 bug) collided distinct
+            # tensors AND broke src<->dst slot correspondence
+            inputs.append(inp.get("opId", -1))
         attrs = {}
         for p in op.get("para", []):
-            if "key" in p and "value" in p:
-                attrs[str(p["key"])] = p["value"]
-        out.append(OpX(_NAME_TO_OP[tname], inputs, attrs))
+            if "key" not in p or "value" not in p:
+                continue
+            key, val = str(p["key"]), p["value"]
+            if key == "PM_ACTI":
+                if val not in _TASO_ACTI:
+                    raise KeyError(f"PM_ACTI={val}")
+                name = _TASO_ACTI[val]
+                mode = ActiMode.AC_MODE_NONE if name is None \
+                    else getattr(ActiMode, name)
+                # src constraint accepts both spellings of "no activation";
+                # dst override must be one concrete value
+                attrs["activation"] = mode if dst else (
+                    (None, ActiMode.AC_MODE_NONE)
+                    if name is None else mode)
+            elif key.startswith("PM_"):
+                # structural parameters (PM_NUMDIM, PM_NUM_INPUTS, PM_AXIS,
+                # PM_PARALLEL_*) are either enforced by the pattern edges
+                # already or use the reference's reversed-dims indexing —
+                # dropping them widens matching, and soundness is kept by
+                # apply()'s hard output-shape check plus the cost gate
+                continue
+            else:
+                attrs[key] = val
+        if dst:
+            out.append(OpX(_NAME_TO_OP[tname], inputs,
+                           attr_overrides=attrs))
+        else:
+            out.append(OpX(_NAME_TO_OP[tname], inputs, attrs))
     return out
 
 

@@ -1,0 +1,43 @@
+"""Memory-pressured transformer search win (VERDICT r4 item 6; reference:
+memory-aware search, /root/reference/src/runtime/graph.cc:2060-2133).
+
+BERT-Large at batch 512 needs ~19.4 GiB/chip under pure DP-8 by the
+grounded memory model — infeasible on v5e's 16 GiB. The search must find a
+feasible strategy itself. Activations dominate and shard identically under
+every (dp, tp) factorization, so the real escape is GPipe microbatching
+(live activations / n_micro); bench.py's memsearch leg records the same
+regime and the dryrun executes a budget-forced winner end-to-end."""
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.bert import BertConfig, build_bert
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import OpSharding, Simulator
+from flexflow_tpu.search.unity import unity_search
+
+
+def test_search_escapes_infeasible_dp_on_bert_large():
+    config = FFConfig()
+    config.batch_size = 512
+    config.perform_memory_search = True
+    ff = FFModel(config)
+    cfg = BertConfig(batch_size=512, seq_len=512, hidden=1024,
+                     num_heads=16, num_layers=24, intermediate=4096)
+    build_bert(ff, cfg)
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    sim.activation_el = 2  # bf16 activations — the validated model
+
+    dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    _, mem_dp = sim.simulate(pcg, dp8, {})
+    assert mem_dp > machine.hbm_capacity, \
+        "regime must be memory-pressured: raise batch if the model shrinks"
+
+    res = unity_search(pcg.copy(), config, 8, machine=machine,
+                       return_result=True, insert_ir_nodes=False, sim=sim)
+    assert res.sim_memory <= machine.hbm_capacity, \
+        (res.sim_memory, machine.hbm_capacity)
+    # the winner is a genuine strategy change, not DP-with-fingers-crossed
+    assert getattr(res.strategy, "pipeline", None) is not None or \
+        res.mesh_shape[1] > 1, (res.mesh_shape, res.strategy.pipeline)
+    # and it reports a finite simulated time for the feasible plan
+    assert res.sim_time > 0
