@@ -496,6 +496,12 @@ def cost_model_checks(ff, config, measured_step_s: float,
         t_dp = simulate_best(sim8, pcg, dp8, {})
         out["searched_vs_dp_8chip_sim"] = round(t_dp / res.sim_time, 3)
         out["searched_mesh"] = list(res.mesh_shape)
+        # the calibrated search discovers GPipe beats DP at this tiny batch
+        # (per-stage weights remove the full-model gradient allreduce):
+        # record the (pp, dp, n_micro) choice so the mesh row isn't
+        # misread as DP-equals-DP
+        out["searched_pipeline"] = list(res.strategy.pipeline) \
+            if getattr(res.strategy, "pipeline", None) else None
 
         # DLRM leg of the OSDI'22 artifact (scripts/osdi22ae/dlrm.sh):
         # embedding-table parallelism is the searched win there
