@@ -114,3 +114,34 @@ def test_cifar10_default_num_samples_matches_reference():
     assert x_train.shape == (40000, 3, 32, 32)
     assert y_train.shape == (40000, 1)
     assert x_test.shape == (10000, 3, 32, 32)
+
+
+def test_adam_bf16_moments_extension():
+    """AdamOptimizer(moment_dtype=bf16): f32 update math over
+    reduced-precision moment storage — states are bf16, one update stays
+    within bf16 rounding of the f32-moment update, and None (default)
+    keeps exact reference numerics."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer
+
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32))}
+
+    ref = AdamOptimizer(None, alpha=1e-3)
+    ext = AdamOptimizer(None, alpha=1e-3, moment_dtype=jnp.bfloat16)
+    s_ref = ref.init_state(params)
+    s_ext = ext.init_state(params)
+    assert s_ext["m"]["w"].dtype == jnp.bfloat16
+    assert s_ref["m"]["w"].dtype == jnp.float32
+
+    p_ref, s_ref = ref.update(params, grads, s_ref)
+    p_ext, s_ext = ext.update(params, grads, s_ext)
+    assert p_ext["w"].dtype == jnp.float32
+    assert s_ext["m"]["w"].dtype == jnp.bfloat16
+    # first step: moments are (1-b)*g rounded to bf16 -> params agree to
+    # bf16 relative precision
+    np.testing.assert_allclose(np.asarray(p_ref["w"]),
+                               np.asarray(p_ext["w"]), rtol=2e-2, atol=2e-5)
