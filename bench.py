@@ -61,13 +61,33 @@ def tpu_responsive(timeout_s: float = 120.0) -> bool:
         return False
 
 
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_last_good.json")
+
+
 def main():
     # probe BEFORE any jax init in this process: if the device tunnel is
     # wedged, even backend queries hang and cannot be interrupted
     if os.environ.get("JAX_PLATFORMS", "") not in ("cpu",) \
             and not tpu_responsive():
-        print(json.dumps({"metric": "bert_tpu_unresponsive_cpu_fallback",
-                          "value": 0.0, "unit": "MFU", "vs_baseline": 0.0}))
+        out = {"metric": "bert_tpu_unresponsive_cpu_fallback",
+               "value": 0.0, "unit": "MFU", "vs_baseline": 0.0}
+        # echo the most recent SUCCESSFUL on-chip run, clearly labeled —
+        # a transient tunnel outage should not erase the round's measured
+        # numbers from the record
+        try:
+            with open(LAST_GOOD_PATH) as f:
+                out["last_good_onchip_result"] = json.load(f)
+            out["last_good_mtime"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ",
+                time.gmtime(os.path.getmtime(LAST_GOOD_PATH)))
+            out["note"] = ("TPU tunnel unresponsive at bench time; "
+                           "last_good_onchip_result is the most recent "
+                           "successful on-chip run of this same bench "
+                           "(see last_good_mtime)")
+        except (OSError, ValueError):
+            pass  # missing or truncated cache must not break the fallback
+        print(json.dumps(out))
         return
 
     import jax
@@ -149,6 +169,14 @@ def main():
         result.update(dlrm_leg())
         result.update(alexnet_leg())
         result.update(memory_pressure_search_leg())
+        try:  # cache for the tunnel-outage fallback path (atomic: a killed
+            # run must not truncate the previous good record)
+            tmp = LAST_GOOD_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(result, f)
+            os.replace(tmp, LAST_GOOD_PATH)
+        except OSError:
+            pass
     print(json.dumps(result))
 
 
