@@ -16,13 +16,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# per-chip peak bf16 FLOP/s by TPU generation
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
+# per-chip peak bf16 FLOP/s by TPU generation: ONE table, owned by the
+# telemetry subsystem (flexflow_tpu.obs.telemetry.PEAK_FLOPS) so bench MFU
+# and telemetry MFU can never disagree. Imported lazily — nothing
+# flexflow/jax-adjacent may load before the tunnel-responsiveness probe.
 
 # ONE timing recipe shared by the headline and every timed leg (ADVICE r4:
 # they drifted to 30 vs 20 iters). Each timing window ends in a single host
@@ -35,14 +32,15 @@ BENCH_ITERS = 60
 
 
 def detect_peak_flops():
-    import jax
+    # delegate: telemetry owns the table AND the matching/fallback logic
+    from flexflow_tpu.obs.telemetry import PEAK_FLOPS
+    from flexflow_tpu.obs.telemetry import detect_peak_flops as _detect
 
-    kind = jax.devices()[0].device_kind.lower()
-    for gen, peak in PEAK_FLOPS.items():
-        if gen in kind:
-            return peak
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    return PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
+    peak = _detect()
+    if peak is None:  # non-TPU backend: legacy env-driven default
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        return PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
+    return peak
 
 
 def tpu_responsive(timeout_s: float = 120.0) -> bool:
@@ -63,6 +61,24 @@ def tpu_responsive(timeout_s: float = 120.0) -> bool:
 
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_last_good.json")
+# machine-readable phase breakdown of the bench itself (obs subsystem):
+# Chrome-trace JSON summarizable via scripts/trace_summary.py, so rounds can
+# diff where bench time went between PRs
+TELEMETRY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_telemetry.json")
+
+
+def _write_bench_telemetry(tracer, result) -> str:
+    """Write the bench's trace with the result embedded; never raises."""
+    try:
+        from flexflow_tpu.obs import atomic_write_json
+
+        trace = tracer.to_chrome_trace()
+        trace.setdefault("otherData", {})["bench_result"] = result
+        atomic_write_json(TELEMETRY_PATH, trace)
+        return os.path.basename(TELEMETRY_PATH)
+    except Exception:
+        return ""
 
 
 def main():
@@ -103,6 +119,10 @@ def main():
     from flexflow_tpu.models.bert import (BertConfig, bert_train_flops_per_step,
                                           build_bert)
 
+    from flexflow_tpu.obs import enable as obs_enable
+
+    tracer = obs_enable()
+
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
@@ -115,7 +135,8 @@ def main():
     if on_tpu:  # bf16 on the MXU, float32 master weights + loss
         config.compute_dtype = DataType.DT_BFLOAT16
     ff = FFModel(config)
-    build_bert(ff, cfg)
+    with tracer.span("bench_build"):
+        build_bert(ff, cfg)
     ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
 
@@ -128,21 +149,23 @@ def main():
     yd = jax.device_put(y, ff.executor.batch_sharding(y.ndim))
 
     if on_tpu:
-        dt = _time_step(ff, xd, yd)
+        with tracer.span("bench_time_step"):
+            dt = _time_step(ff, xd, yd)
     else:  # CI smoke: one tiny window, no extrapolation
         import jax.random as jrandom
 
-        step = ff.executor.make_train_step()
-        params, opt_state = ff.params, ff.opt_state
-        params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                          jrandom.PRNGKey(0))
-        _ = float(loss)
-        t0 = time.perf_counter()
-        for i in range(3):
+        with tracer.span("bench_time_step"):
+            step = ff.executor.make_train_step()
+            params, opt_state = ff.params, ff.opt_state
             params, opt_state, loss, _ = step(params, opt_state, xd, yd,
-                                              jrandom.PRNGKey(1 + i))
-        _ = float(loss)
-        dt = (time.perf_counter() - t0) / 3
+                                              jrandom.PRNGKey(0))
+            _ = float(loss)
+            t0 = time.perf_counter()
+            for i in range(3):
+                params, opt_state, loss, _ = step(params, opt_state, xd, yd,
+                                                  jrandom.PRNGKey(1 + i))
+            _ = float(loss)
+            dt = (time.perf_counter() - t0) / 3
 
     samples_per_sec = cfg.batch_size / dt
     flops_per_step = bert_train_flops_per_step(cfg)
@@ -161,22 +184,28 @@ def main():
         "model_flops_per_step": flops_per_step,
     }
     if on_tpu:
-        result.update(cost_model_checks(ff, config, dt,
-                                        example_batch=(xd, yd)))
-        result.update(dropout_mfu_leg(cfg, peak))
-        result.update(bf16_moments_leg(cfg, peak))
-        result.update(long_context_leg(peak))
-        result.update(dlrm_leg())
-        result.update(alexnet_leg())
-        result.update(memory_pressure_search_leg())
+        legs = [("cost_model_checks",
+                 lambda: cost_model_checks(ff, config, dt,
+                                           example_batch=(xd, yd))),
+                ("dropout_mfu_leg", lambda: dropout_mfu_leg(cfg, peak)),
+                ("bf16_moments_leg", lambda: bf16_moments_leg(cfg, peak)),
+                ("long_context_leg", lambda: long_context_leg(peak)),
+                ("dlrm_leg", dlrm_leg),
+                ("alexnet_leg", alexnet_leg),
+                ("memory_pressure_search_leg", memory_pressure_search_leg)]
+        for name, leg in legs:
+            with tracer.span(name):
+                result.update(leg())
         try:  # cache for the tunnel-outage fallback path (atomic: a killed
             # run must not truncate the previous good record)
-            tmp = LAST_GOOD_PATH + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(result, f)
-            os.replace(tmp, LAST_GOOD_PATH)
+            from flexflow_tpu.obs import atomic_write_json
+
+            atomic_write_json(LAST_GOOD_PATH, result)
         except OSError:
             pass
+    tf = _write_bench_telemetry(tracer, result)
+    if tf:
+        result["telemetry_file"] = tf
     print(json.dumps(result))
 
 
@@ -253,9 +282,11 @@ def _memory_ratio(ff, suffix: str, xd, yd, activation_el=None) -> dict:
         sim.activation_el = activation_el
         dp1 = {n.guid: OpSharding(dp=1) for n in pcg.compute_nodes()}
         _, analytic = sim.simulate(pcg, dp1, {})
+        from flexflow_tpu.obs.telemetry import peak_memory_bytes
+
         ma = ff.executor.train_step_memory_analysis(ff.params, ff.opt_state,
                                                     xd, yd)
-        xla_peak = int(ma.peak_memory_in_bytes) if ma else 0
+        xla_peak = peak_memory_bytes(ma) or 0
         if xla_peak > 0:
             out[f"mem_analytic_mb_{suffix}"] = round(analytic / 2 ** 20, 1)
             out[f"mem_xla_peak_mb_{suffix}"] = round(xla_peak / 2 ** 20, 1)
@@ -509,11 +540,13 @@ def cost_model_checks(ff, config, measured_step_s: float,
         # peak_memory_in_bytes for the SAME (dp=1) strategy
         try:  # own guard: must not sink the searched-vs-DP legs below
             if example_batch is not None:
+                from flexflow_tpu.obs.telemetry import peak_memory_bytes
+
                 xd, yd = example_batch
                 _, mem_analytic = sim.simulate(pcg, dp1, {})
                 ma = ff.executor.train_step_memory_analysis(
                     ff.params, ff.opt_state, xd, yd)
-                xla_peak = int(ma.peak_memory_in_bytes) if ma else 0
+                xla_peak = peak_memory_bytes(ma) or 0
                 if xla_peak > 0:
                     out["mem_analytic_mb"] = round(
                         mem_analytic / 2 ** 20, 1)

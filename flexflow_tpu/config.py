@@ -104,6 +104,15 @@ class FFConfig:
     # Legion Prof analog (-lg:prof / -lg:prof_logfile): when set, fit() runs
     # under jax.profiler.trace writing an XLA/TensorBoard trace here
     profiler_trace_dir: str = ""
+    # obs subsystem (flexflow_tpu/obs): Chrome trace-event JSON of host-side
+    # phases (compile / step / epoch / eval / search), Perfetto-loadable
+    trace_file: str = ""
+    # per-run training telemetry JSON (step walls, loss history, compile vs
+    # steady split, samples/sec, estimated MFU, XLA peak memory)
+    telemetry_file: str = ""
+    # Unity/MCMC per-iteration JSONL log (candidate cost, accept/reject,
+    # temperature, best-so-far) — mirrors the strategy-export workflow
+    search_log_file: str = ""
     perform_auto_mapping: bool = False
     # numerical-safety checks — the TPU analog of the reference's reliance on
     # Legion region coherence for race freedom (SURVEY §5: XLA purity plays
@@ -125,7 +134,19 @@ class FFConfig:
     )
 
     def __post_init__(self) -> None:
-        argv = sys.argv[1:] if "pytest" not in os.path.basename(sys.argv[0]) else []
+        # under pytest the process argv belongs to the test runner, whose
+        # flags collide with ours (pytest's ``-p no:cacheprovider`` would be
+        # read as ``--print-freq``); argv[0] basename alone misses
+        # ``python -m pytest`` (argv[0] is .../pytest/__main__.py). Only
+        # argv[0] is consulted — env markers (PYTEST_CURRENT_TEST) inherit
+        # into subprocesses a test launches, and those are real production
+        # processes whose flags must parse; same for ``"pytest" in
+        # sys.modules``, true in anything that imports pytest transitively
+        a0 = sys.argv[0]
+        under_pytest = ("pytest" in os.path.basename(a0)
+                        or a0.replace(os.sep, "/").endswith(
+                            ("pytest/__main__.py", "py.test")))
+        argv = sys.argv[1:] if not under_pytest else []
         self.parse_args(argv)
         if self.workers_per_node == 0:
             try:
@@ -227,6 +248,12 @@ class FFConfig:
                 # Legion Prof analog: dump a jax.profiler (XLA/TensorBoard)
                 # trace of the training loop to this directory
                 self.profiler_trace_dir = _next()
+            elif a == "--trace-file":
+                self.trace_file = _next()
+            elif a == "--telemetry-file":
+                self.telemetry_file = _next()
+            elif a in ("--search-log", "--search-log-file"):
+                self.search_log_file = _next()
             elif a == "--seed":
                 self.seed = int(_next())
             elif a == "--mesh-shape":

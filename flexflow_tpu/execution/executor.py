@@ -195,7 +195,12 @@ class Executor:
                 seq_length=ctx.seq_length, mesh=ctx.mesh,
                 profiling=ctx.profiling, aux_losses=ctx.aux_losses,
                 cache_in=ctx.cache_in, cache_out=ctx.cache_out)
-            outs = op.forward(node_params, inputs, node_ctx)
+            # per-op named scope: op names become HLO metadata, so XLA/xprof
+            # timelines attribute fused kernels back to PCG nodes (the
+            # reference gets this from per-op Legion task names in Legion
+            # Prof; here it is free at trace time, zero cost at run time)
+            with jax.named_scope(node.name):
+                outs = op.forward(node_params, inputs, node_ctx)
             # apply the strategy's output sharding constraint (parallel ops and
             # any node the search pinned)
             ns = self.strategy.node_strategies.get(node.guid)
@@ -244,10 +249,13 @@ class Executor:
         opt = self.optimizer
         has_cache = bool(self.cache_nodes)
 
+        profiling = bool(getattr(self.config, "profiling", False))
+
         def loss_fn(params, xs, labels, rng, cache):
             params_c, xs = self._cast_for_compute(params, xs)
             cache_out = {}
             ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[],
+                            profiling=profiling,
                             cache_in=cache, cache_out=cache_out)
             values = self.forward_outputs(params_c, self._bind_inputs(xs), ctx)
             logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
@@ -303,9 +311,12 @@ class Executor:
             return self._eval_step
         mesh = self.mesh
 
+        profiling = bool(getattr(self.config, "profiling", False))
+
         def estep(params, xs, labels):
             params, xs = self._cast_for_compute(params, xs)
-            ctx = OpContext(training=False, rng=None, mesh=mesh)
+            ctx = OpContext(training=False, rng=None, mesh=mesh,
+                            profiling=profiling)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
             logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
             loss = loss_value(self.loss_type, logits, labels, self.repl_labels)
@@ -323,9 +334,12 @@ class Executor:
             return self._forward_jit
         mesh = self.mesh
 
+        profiling = bool(getattr(self.config, "profiling", False))
+
         def fwd(params, xs):
             params, xs = self._cast_for_compute(params, xs)
-            ctx = OpContext(training=False, rng=None, mesh=mesh)
+            ctx = OpContext(training=False, rng=None, mesh=mesh,
+                            profiling=profiling)
             values = self.forward_outputs(params, self._bind_inputs(xs), ctx)
             return values[self.final_guid][self.final_out_idx]
 

@@ -80,6 +80,66 @@ class VerifyMetrics(Callback):
             assert 0, "Accuracy is wrong"
 
 
+class TelemetryCallback(Callback):
+    """Streams epoch/train progress into the obs tracer and (optionally)
+    writes a merged telemetry summary at train end — the Keras-surface entry
+    point to the tracing/telemetry subsystem (no reference analog; the
+    reference's callbacks only print).
+
+    The keras fit loop drives ``ffmodel.fit(epochs=1)`` once per epoch, so
+    each epoch yields its own StepTelemetry; this callback collects every
+    epoch's summary and writes one ``{"epochs": [...]}`` record (only the
+    first epoch's first step carries the jit compile)."""
+
+    def __init__(self, telemetry_file=None):
+        super().__init__()
+        self.telemetry_file = telemetry_file
+        self.epoch_summaries = []
+
+    def _tracer(self):
+        from ..obs import get_tracer
+
+        return get_tracer()
+
+    def on_train_begin(self, logs=None):
+        # the callback's telemetry_file IS an observability opt-in: flag the
+        # model so fit() records a StepTelemetry even with no config sinks
+        if self.telemetry_file and self.model is not None:
+            self.model.ffmodel._telemetry_requested = True
+        self.epoch_summaries = []
+        self._tracer().event("keras_train_begin")
+
+    def on_epoch_end(self, epoch, logs=None):
+        tel = self.model.ffmodel.get_telemetry()
+        if tel is not None:
+            tel.finalize()
+            self.epoch_summaries.append(dict(tel.summary(), epoch=epoch))
+        if self.telemetry_file:
+            # the keras fit loop drives one ffmodel.fit per epoch and each
+            # fit CONSUMES the request flag — re-arm for the next epoch
+            self.model.ffmodel._telemetry_requested = True
+        tracer = self._tracer()
+        if not tracer.enabled:
+            return
+        perf = self.model.ffmodel.get_perf_metrics()
+        tracer.event("keras_epoch_end", epoch=epoch,
+                     accuracy=round(perf.accuracy(), 4),
+                     train_all=perf.train_all)
+
+    def on_train_end(self, logs=None):
+        self._tracer().event("keras_train_end")
+        if self.model is not None:
+            # scoped opt-in: a later fit() without this callback must not
+            # stay instrumented (telemetry costs a per-step device sync)
+            self.model.ffmodel._telemetry_requested = False
+        if self.telemetry_file and self.epoch_summaries:
+            from ..obs import atomic_write_json
+
+            atomic_write_json(self.telemetry_file,
+                              {"phase": "train",
+                               "epochs": self.epoch_summaries})
+
+
 class EpochVerifyMetrics(Callback):
     """reference: callbacks.py EpochVerifyMetrics — early-stops once the
     per-epoch accuracy passes the bar."""

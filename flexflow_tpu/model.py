@@ -462,6 +462,59 @@ class FFModel:
         return self.aggregate(topk_values, topk_assign, topk_assign, gate,
                               [exp_out], num_exp, lambda_bal)
 
+    # ======================================================== observability ==
+    def _obs_tracer(self):
+        """The process tracer, auto-enabled the first time when the config
+        asks for a trace file (obs stays a no-op singleton otherwise)."""
+        from .obs import enable, get_tracer
+
+        t = get_tracer()
+        if not t.enabled and self.config.trace_file:
+            t = enable(trace_file=self.config.trace_file)
+        return t
+
+    def get_telemetry(self):
+        """StepTelemetry of the most recent fit() (None when observability
+        was disabled for that run)."""
+        return getattr(self, "_telemetry", None)
+
+    def _make_telemetry(self, tracer, batch_size: int, phase: str):
+        """A StepTelemetry when either sink wants one, else None — the
+        None-ness is the hot loop's single instrumentation gate.
+        ``_telemetry_requested`` is the in-process opt-in used by callers
+        that consume get_telemetry() directly (keras TelemetryCallback).
+        It is CONSUMED here (one fit per arm): if the requester dies before
+        its cleanup hook, at most one later fit runs instrumented."""
+        requested = getattr(self, "_telemetry_requested", False)
+        if requested:
+            self._telemetry_requested = False
+        if not (self.config.telemetry_file or tracer.enabled or requested):
+            return None
+        from .obs.telemetry import (StepTelemetry, detect_peak_flops,
+                                    model_flops_per_step)
+
+        tel = StepTelemetry(batch_size=batch_size, phase=phase)
+        try:
+            if self.pcg is not None:
+                tel.flops_per_step = model_flops_per_step(self.pcg)
+        except Exception:
+            pass
+        peak = detect_peak_flops()  # per chip
+        if peak is not None:
+            # the step's model FLOPs cover the whole global batch, executed
+            # across the chips the step actually runs on — MFU divides by
+            # the EXECUTOR MESH's peak (a sub-mesh run must not be judged
+            # against idle chips)
+            if self.mesh is not None:
+                n_chips = int(self.mesh.devices.size)
+            else:
+                import jax
+
+                n_chips = len(jax.devices())
+            peak *= max(n_chips, 1)
+        tel.peak_flops = peak
+        return tel
+
     # ============================================================== compile ==
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
@@ -469,6 +522,26 @@ class FFModel:
                 comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
                 strategy=None, strategy_fn=None,
                 final_tensor: Optional[Tensor] = None) -> None:
+        """Traced wrapper over :meth:`_compile_impl` — the whole lowering
+        pipeline (PCG build, strategy search, executor + param init) lands as
+        one "compile" span in the obs trace. The explicit signature is kept
+        in sync with ``_compile_impl`` (it IS the public API surface the
+        frontends introspect)."""
+        tracer = self._obs_tracer()
+        with tracer.span("compile", layers=len(self._layers)):
+            self._compile_impl(optimizer, loss_type, metrics, comp_mode,
+                               strategy, strategy_fn, final_tensor)
+        if tracer.enabled and self.config.trace_file:
+            # flush after each top-level phase so compile-only sessions
+            # (and crashes later on) still leave a loadable trace
+            tracer.write(self.config.trace_file)
+
+    def _compile_impl(self, optimizer: Optional[Optimizer] = None,
+                      loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      metrics: Optional[List[MetricsType]] = None,
+                      comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+                      strategy=None, strategy_fn=None,
+                      final_tensor: Optional[Tensor] = None) -> None:
         """Lower the Layer graph to a PCG, pick a strategy, build the executor
         (reference pipeline: src/runtime/model.cc:2803, SURVEY §3.3).
 
@@ -765,6 +838,13 @@ class FFModel:
         loss_val = None
         cache = (self.executor.init_cache()
                  if self.executor.cache_nodes else None)
+        # observability: with both sinks off, `telemetry` is None and the hot
+        # loop pays two `if x is not None` tests per step — no allocations,
+        # no file I/O, no device syncs beyond the pre-existing ones
+        tracer = self._obs_tracer()
+        telemetry = self._make_telemetry(tracer, batch_size, "train")
+        self._telemetry = telemetry
+        last_batch = None
         if self.config.profiling:
             self.profile_operators()
             t0 = time.time()  # per-op measurement must not skew THROUGHPUT
@@ -783,9 +863,12 @@ class FFModel:
                                     seed=self.config.numpy_seed() + epoch)
                 epoch_metrics = []  # device-side; folded at epoch end (async)
                 recompiled = False
+                t_epoch = time.perf_counter()
                 for batch in prefetch_iterator(
                         it, in_shardings + [label_sharding]):
                     bx, by = batch[:-1], batch[-1]
+                    if telemetry is not None:
+                        t_step = time.perf_counter()
                     if cache is not None:
                         (self.params, self.opt_state, loss_val, m,
                          fresh) = step_fn(self.params, self.opt_state, bx, by,
@@ -798,6 +881,17 @@ class FFModel:
                             self._next_rng())
                     epoch_metrics.append(m)
                     step_count += 1
+                    loss_f = None
+                    if telemetry is not None:
+                        # observability is opt-in: the per-step sync it costs
+                        # is what buys true step walls + the compile split
+                        jax.block_until_ready(loss_val)
+                        wall = time.perf_counter() - t_step
+                        loss_f = float(loss_val)
+                        telemetry.record_step(wall, loss_f)
+                        tracer.complete("train_step", wall, step=step_count,
+                                        loss=loss_f)
+                        last_batch = (bx, by)
                     if self._recompile_state is not None and \
                             self.recompile_on_condition(self._recompile_state):
                         # executor rebuilt: refresh the jitted step and cache,
@@ -810,16 +904,28 @@ class FFModel:
                         break
                     if self.config.profiling and \
                             step_count % max(self.config.print_freq, 1) == 0:
-                        print(f"step {step_count}: loss={float(loss_val):.4f}")
+                        # legacy stdout line, byte-identical to the pre-obs
+                        # print so existing scripts keep parsing it
+                        print(f"step {step_count}: loss="
+                              f"{float(loss_val) if loss_f is None else loss_f:.4f}")
                 # fold whatever the epoch produced (also the partial pre-recompile
-                # batches — their steps trained the old graph but still count)
-                for m in epoch_metrics:
-                    self._perf.update({k: np.asarray(v) for k, v in m.items()})
+                # batches — their steps trained the old graph but still count);
+                # ONE host transfer for the whole epoch instead of a blocking
+                # int()/float() per scalar per step
+                if epoch_metrics:
+                    for m in jax.device_get(epoch_metrics):
+                        self._perf.update(m)
                 if recompiled:
                     in_shardings = [self.executor.batch_sharding(a.ndim)
                                     for a in xs]
                     label_sharding = self.executor.batch_sharding(y.ndim)
                     continue  # restart the SAME epoch
+                if telemetry is not None:
+                    loss_f = (float(loss_val) if loss_val is not None
+                              else None)
+                    telemetry.record_epoch(loss_f)
+                    tracer.complete("epoch", time.perf_counter() - t_epoch,
+                                    index=epoch, loss=loss_f)
                 if self.config.profiling:
                     print(f"epoch {epoch}: loss={float(loss_val):.4f}")
                 epoch += 1
@@ -831,9 +937,25 @@ class FFModel:
         elapsed = time.time() - t0
         self._last_fit_time = elapsed
         self._last_fit_samples = steps_per_epoch * batch_size * epochs
-        if self.config.profiling and elapsed > 0:
-            print(f"THROUGHPUT = {self._last_fit_samples / elapsed:.2f} "
-                  f"samples/s")
+        if elapsed > 0:
+            throughput = self._last_fit_samples / elapsed
+            if tracer.enabled:
+                tracer.counter("throughput_samples_per_sec",
+                               round(throughput, 2))
+            if self.config.profiling:
+                # legacy stdout line (kept verbatim for script compatibility)
+                print(f"THROUGHPUT = {throughput:.2f} samples/s")
+        if telemetry is not None:
+            telemetry.finalize()
+            if self.config.telemetry_file and last_batch is not None:
+                from .obs.telemetry import capture_memory_analysis
+
+                telemetry.device_memory = capture_memory_analysis(
+                    self.executor, self.params, self.opt_state, *last_batch)
+            if self.config.telemetry_file:
+                telemetry.write(self.config.telemetry_file)
+        if tracer.enabled and self.config.trace_file:
+            tracer.write(self.config.trace_file)
         return self._perf
 
     def _param_stamp(self):
@@ -883,24 +1005,40 @@ class FFModel:
             LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: "mse_loss",
         }.get(self.loss_type, "sparse_cce_loss")
         self._perf = PerfMetrics()
+        tracer = self._obs_tracer()
+        telemetry = self._make_telemetry(tracer, batch_size, "train_pipeline")
+        self._telemetry = telemetry
         t0 = time.time()
         step = 0
         loss = None
         for epoch in range(epochs):
             it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
                                 seed=self.config.numpy_seed() + epoch)
+            t_epoch = time.perf_counter()
             for batch in it:
                 bx, by = batch[:-1], batch[-1]
+                t_step = time.perf_counter()
                 loss = tr.train_step(list(bx), by, rng_seed=step)
                 step += 1
                 # loss-only metrics: train_step returns the scalar loss
                 # (accuracy-style metrics need the eval path)
+                loss_f = float(loss)
+                if telemetry is not None:
+                    wall = time.perf_counter() - t_step
+                    telemetry.record_step(wall, loss_f)
+                    tracer.complete("train_step", wall, step=step,
+                                    loss=loss_f)
                 self._perf.update({
                     "train_all": by.shape[0],
-                    loss_key: float(loss) * by.shape[0]})
+                    loss_key: loss_f * by.shape[0]})
                 if self.config.profiling and \
                         step % max(self.config.print_freq, 1) == 0:
-                    print(f"step {step}: loss={float(loss):.4f}")
+                    print(f"step {step}: loss={loss_f:.4f}")
+            if telemetry is not None:
+                telemetry.record_epoch(float(loss) if loss is not None
+                                       else None)
+                tracer.complete("epoch", time.perf_counter() - t_epoch,
+                                index=epoch)
         for lname, ws in tr.export_params().items():
             for wname, arr in ws.items():
                 cur = self.params[lname][wname]
@@ -912,27 +1050,53 @@ class FFModel:
         self._pipeline_param_stamp = self._param_stamp()
         self._last_fit_time = time.time() - t0
         self._last_fit_samples = step * batch_size
-        if self.config.profiling and self._last_fit_time > 0:
-            print(f"THROUGHPUT = "
-                  f"{self._last_fit_samples / self._last_fit_time:.2f} "
-                  f"samples/s")
+        if self._last_fit_time > 0:
+            throughput = self._last_fit_samples / self._last_fit_time
+            if tracer.enabled:
+                tracer.counter("throughput_samples_per_sec",
+                               round(throughput, 2))
+            if self.config.profiling:
+                print(f"THROUGHPUT = {throughput:.2f} samples/s")
+        if telemetry is not None:
+            telemetry.finalize()
+            if self.config.telemetry_file:
+                telemetry.write(self.config.telemetry_file)
+        if tracer.enabled and self.config.trace_file:
+            tracer.write(self.config.trace_file)
         return self._perf
 
     def eval(self, x=None, y=None, batch_size: Optional[int] = None
              ) -> PerfMetrics:
         """reference: flexflow_cffi.py:2102."""
+        import jax
+
         xs = self._as_input_list(x)
         y = self._prep_label(y)
         batch_size = batch_size or self.config.batch_size
         estep = self.executor.make_eval_step()
         from .data.dataloader import batch_iterator
 
+        tracer = self._obs_tracer()
         perf = PerfMetrics()
+        t_eval = time.perf_counter()
+        n_batches = 0
+        loss_val = None
         for batch in batch_iterator(xs + [y], batch_size,
                                     drop_remainder=False):
             bx, by = batch[:-1], batch[-1]
             loss_val, m = estep(self.params, bx, by)
-            perf.update({k: np.asarray(v) for k, v in m.items()})
+            # one host transfer per batch instead of one per metric scalar
+            perf.update(jax.device_get(m))
+            n_batches += 1
+        if tracer.enabled:
+            tracer.complete("eval", time.perf_counter() - t_eval,
+                            batches=n_batches,
+                            loss=(float(loss_val) if loss_val is not None
+                                  else None))
+            if self.config.trace_file:
+                # eval-only / inference workloads must still get their
+                # trace file — fit() is not the only exit point
+                tracer.write(self.config.trace_file)
         return perf
 
     def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
@@ -1097,6 +1261,9 @@ class FFModel:
                 est = sim.op_cost(node, in_shapes, OpSharding()).forward_time
                 distinct[key] = (est, node, in_shapes)
         heaviest = sorted(distinct.values(), key=lambda x: -x[0])[:max_ops]
+        tracer = self._obs_tracer()
+        # legacy stdout block kept verbatim; the same measurements also land
+        # as machine-readable tracer events
         print("PER-OP PROFILE (fwd, measured standalone, "
               f"top {len(heaviest)} by estimated cost):")
         for _est, node, in_shapes in heaviest:
@@ -1104,6 +1271,10 @@ class FFModel:
                 t = sim.measure_operator_cost(node, in_shapes)
             except Exception:
                 continue
+            if tracer.enabled:
+                tracer.event("per_op_profile", op=node.name,
+                             op_type=node.op.op_type.name,
+                             forward_us=round(t * 1e6, 1))
             print(f"  {node.name:24s} {node.op.op_type.name:28s} "
                   f"{t * 1e6:10.1f} us")
 

@@ -1,0 +1,250 @@
+"""Step and search telemetry: machine-readable training-run records.
+
+``StepTelemetry`` is filled by ``FFModel.fit``/``eval``: per-step wall time,
+loss/metric history, samples/sec, the first-step (jit compile) time split
+from steady state, estimated MFU from the analytic cost model, and the
+XLA-compiled peak memory (``Executor.train_step_memory_analysis``). The
+summary is a plain-JSON dict written to ``--telemetry-file``.
+
+``SearchLog`` is the Unity/MCMC per-iteration log (candidate cost,
+accept/reject, temperature, best-so-far), streamed as JSONL when
+``--search-log`` is set and mirrored to the process tracer — the machine-
+readable replacement for watching the search's debug logging scroll by
+(reference: the strategy-export workflow plus Legion Prof's search phase).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from .trace import get_tracer
+
+# per-chip peak bf16 FLOP/s by TPU generation — the canonical copy
+# (bench.py imports this table; keep new generations here)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak_flops() -> Optional[float]:
+    """Per-chip peak FLOP/s of the current backend, or None off-TPU (an MFU
+    against a CPU 'peak' would be meaningless). Unknown TPU generations fall
+    back to PALLAS_AXON_TPU_GEN, then v5e — the ONE implementation bench.py
+    delegates to, so bench MFU and telemetry MFU always use the same peak."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None
+        kind = dev.device_kind.lower()
+        for gen, peak in PEAK_FLOPS.items():
+            if gen in kind:
+                return peak
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        return PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
+    except Exception:
+        return None
+
+
+def model_flops_per_step(pcg, backward: bool = True) -> int:
+    """Analytic model FLOPs for one training step from the existing per-op
+    cost hooks (Op.flops; reference: measure_operator_cost's analytical
+    side). Backward is costed as 2x forward — the standard grad-of-matmul
+    accounting the simulator also uses."""
+    total = 0
+    for node in pcg.compute_nodes():
+        in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+        try:
+            total += int(node.op.flops(in_shapes, list(node.out_shapes)))
+        except Exception:
+            continue  # ops without a cost hook contribute 0
+    return total * 3 if backward else total
+
+
+class StepTelemetry:
+    """Accumulates per-step records host-side; nothing device-facing happens
+    here (the caller hands in already-transferred host scalars)."""
+
+    def __init__(self, batch_size: int = 0, phase: str = "train"):
+        self.phase = phase
+        self.batch_size = batch_size
+        self.step_wall_s: List[float] = []
+        self.loss_history: List[float] = []
+        self.epoch_loss: List[float] = []
+        self.metric_history: List[Dict[str, float]] = []
+        self.flops_per_step: Optional[int] = None
+        self.peak_flops: Optional[float] = None
+        self.device_memory: Optional[Dict[str, int]] = None
+        self.total_wall_s: float = 0.0
+        self._t_start = time.perf_counter()
+
+    # -- recording ----------------------------------------------------------
+    def record_step(self, wall_s: float, loss: Optional[float] = None,
+                    metrics: Optional[Dict[str, float]] = None) -> None:
+        self.step_wall_s.append(wall_s)
+        if loss is not None:
+            self.loss_history.append(float(loss))
+        if metrics:
+            self.metric_history.append(
+                {k: float(v) for k, v in metrics.items()})
+
+    def record_epoch(self, loss: Optional[float] = None) -> None:
+        if loss is not None:
+            self.epoch_loss.append(float(loss))
+
+    def finalize(self) -> None:
+        self.total_wall_s = time.perf_counter() - self._t_start
+
+    # -- derived numbers ----------------------------------------------------
+    @property
+    def steps(self) -> int:
+        return len(self.step_wall_s)
+
+    def first_step_s(self) -> Optional[float]:
+        """First-step wall time — dominated by jit compile."""
+        return self.step_wall_s[0] if self.step_wall_s else None
+
+    def steady_step_s(self) -> Optional[float]:
+        """Median steady-state step time, compile step excluded. None when
+        only the compile step was recorded — deriving throughput/MFU from a
+        wall that is mostly XLA compile would be silently misleading."""
+        rest = sorted(self.step_wall_s[1:])
+        return rest[len(rest) // 2] if rest else None
+
+    def samples_per_sec(self) -> Optional[float]:
+        st = self.steady_step_s()
+        if not st or not self.batch_size:
+            return None
+        return self.batch_size / st
+
+    def mfu(self) -> Optional[float]:
+        st = self.steady_step_s()
+        if not st or not self.flops_per_step or not self.peak_flops:
+            return None
+        return (self.flops_per_step / st) / self.peak_flops
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "phase": self.phase,
+            "steps": self.steps,
+            "batch_size": self.batch_size,
+            "total_wall_s": round(self.total_wall_s, 4),
+            "loss_history": self.loss_history,
+            "epoch_loss": self.epoch_loss,
+        }
+        if self.step_wall_s:
+            out["first_step_s"] = round(self.first_step_s(), 6)
+            steady = self.steady_step_s()
+            if steady is not None:
+                out["steady_step_s"] = round(steady, 6)
+                out["compile_overhead_s"] = round(
+                    max(self.first_step_s() - steady, 0.0), 6)
+        sps = self.samples_per_sec()
+        if sps is not None:
+            out["samples_per_sec"] = round(sps, 2)
+        if self.flops_per_step:
+            out["model_flops_per_step"] = self.flops_per_step
+        mfu = self.mfu()
+        if mfu is not None:
+            out["estimated_mfu"] = round(mfu, 4)
+            out["peak_flops"] = self.peak_flops
+        if self.device_memory:
+            out["device_memory"] = self.device_memory
+        if self.metric_history:
+            out["metric_history"] = self.metric_history
+        return out
+
+    def write(self, path: str) -> str:
+        from .trace import atomic_write_json
+
+        return atomic_write_json(path, self.summary())
+
+
+def peak_memory_bytes(ma) -> Optional[int]:
+    """XLA peak memory from a CompiledMemoryStats, across jax versions:
+    newer jaxlibs expose ``peak_memory_in_bytes`` directly; older ones only
+    the component sizes, from which arguments + outputs + temps minus
+    aliased (donated) buffers is the standard reconstruction."""
+    if ma is None:
+        return None
+    v = getattr(ma, "peak_memory_in_bytes", None)
+    if v is not None and int(v) > 0:
+        return int(v)
+    try:
+        tot = (int(ma.argument_size_in_bytes) + int(ma.output_size_in_bytes)
+               + int(ma.temp_size_in_bytes)
+               - int(getattr(ma, "alias_size_in_bytes", 0)))
+        return tot if tot > 0 else None
+    except AttributeError:
+        return None
+
+
+def capture_memory_analysis(executor, params, opt_state, xs, labels
+                            ) -> Optional[Dict[str, int]]:
+    """Best-effort XLA compiled-memory capture for the telemetry record.
+    Never raises: memory stats are advisory and some backends don't expose
+    them."""
+    try:
+        ma = executor.train_step_memory_analysis(params, opt_state, xs,
+                                                 labels)
+        if ma is None:
+            return None
+        out = {}
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, field, None)
+            if v is not None:
+                out[field] = int(v)
+        peak = peak_memory_bytes(ma)
+        if peak is not None:
+            out["peak_memory_in_bytes"] = peak
+        return out or None
+    except Exception:
+        return None
+
+
+class SearchLog:
+    """Per-iteration search telemetry sink. Every ``log()`` lands as a JSONL
+    line (when ``path`` is set) and as an instant event on the process tracer
+    (when tracing is enabled) — one call site, both sinks. Safe to construct
+    unconditionally: with no path and tracing disabled it degrades to a
+    counter."""
+
+    def __init__(self, path: Optional[str] = None, kind: str = "unity"):
+        self.path = path
+        self.kind = kind
+        self.iterations = 0
+        self._fh = None  # set BEFORE open(): __del__ must find the attr
+        # even when open() raises on a bad path
+        if path:
+            # line-buffered: the log is for WATCHING a live search (tail
+            # -f) and must survive a mid-search kill
+            self._fh = open(path, "a", buffering=1)
+
+    def log(self, **rec) -> None:
+        self.iterations += 1
+        rec.setdefault("search", self.kind)
+        rec.setdefault("iter", self.iterations)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, default=str) + "\n")
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.event(f"{self.kind}_iter", **rec)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+    def __del__(self):
+        # a search that raises mid-run drops its SearchLog frame without
+        # reaching the explicit close(); refcount collection closes the fd
+        # (writes are line-buffered, so no records are lost either way)
+        self.close()

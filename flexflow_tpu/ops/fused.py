@@ -98,8 +98,12 @@ class FusedOp(Op):
                 rng=(jax.random.fold_in(ctx.rng, i)
                      if ctx.rng is not None else None),
                 seq_length=ctx.seq_length, mesh=ctx.mesh,
-                profiling=ctx.profiling, aux_losses=ctx.aux_losses)
-            sub_outs.append(sub.forward(sub_params, ins, sub_ctx))
+                profiling=ctx.profiling, aux_losses=ctx.aux_losses,
+                cache_in=ctx.cache_in, cache_out=ctx.cache_out)
+            # sub-op named scope: xprof attributes work inside the region
+            # to the member ops, not just the FusedOp node
+            with jax.named_scope(sub.name):
+                sub_outs.append(sub.forward(sub_params, ins, sub_ctx))
         return sub_outs[-1]
 
     # -- cost model: one roofline over the region --------------------------------

@@ -536,6 +536,9 @@ class Simulator:
             import jax.numpy as jnp
 
             self.activation_el = jnp.dtype(compute_dtype).itemsize
+        from ..obs import get_tracer
+
+        tracer = get_tracer()
         measured = 0
         for node in pcg.compute_nodes():
             in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
@@ -562,6 +565,16 @@ class Simulator:
                 self._key_calibration[key] = \
                     max(t - self.op_overhead, 0.1 * t) / analytical
                 measured += 1
+                if tracer.enabled:
+                    # calibration record: how far the roofline was off for
+                    # this op shape (the search's ground-truth anchor)
+                    tracer.event(
+                        "op_calibration", op=node.name,
+                        op_type=node.op.op_type.name,
+                        measured_us=round(t * 1e6, 2),
+                        analytical_us=round(
+                            (analytical + self.op_overhead) * 1e6, 2),
+                        ratio=round(self._key_calibration[key], 4))
                 # measured backward: time fwd+bwd together (what training
                 # compiles) and store the bwd/fwd ratio, replacing the
                 # flat 2x heuristic (reference: simulator.cc:537)

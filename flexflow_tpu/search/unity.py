@@ -951,14 +951,16 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         space: Optional[SearchSpace] = None,
                         lam: float = 1.0,
                         protected_guids: Sequence[int] = (),
-                        split_threshold: int = 0
+                        split_threshold: int = 0,
+                        search_log=None
                         ) -> Tuple[PCG, Dict[int, OpSharding],
                                    Dict[int, str], float]:
     """The reference's base_optimize (substitution.cc:2229-2306): best-first
     search over GraphXfer applications, each candidate costed by the DP, with
     alpha pruning and a budget on explored graphs. Above ``split_threshold``
     compute nodes, rewrites are confined to bottleneck-delimited segments —
-    the reference's recursive split at find_split_node."""
+    the reference's recursive split at find_split_node. ``search_log``
+    (obs.SearchLog) records every explored rewrite candidate."""
     assignment, states, t = dp_assign(pcg, sim, dp, tp, batch, space, lam)
     best = (pcg, assignment, states, t)
     if not xfers:
@@ -995,6 +997,11 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 a2, s2, t2 = dp_assign(g2, sim, dp, tp, batch, space, lam)
                 _log.info("xfer %s: %.3f ms -> %.3f ms", xfer.name,
                           best[3] * 1e3, t2 * 1e3)
+                if search_log is not None:
+                    search_log.log(event="xfer", xfer=xfer.name, dp=dp,
+                                   tp=tp, cost_ms=round(t2 * 1e3, 4),
+                                   accepted=bool(t2 < best[3]),
+                                   best_ms=round(min(t2, best[3]) * 1e3, 4))
                 if t2 < best[3]:
                     best = (g2, a2, s2, t2)
                 if t2 < best[3] * alpha:
@@ -1057,12 +1064,25 @@ def unity_search(pcg: PCG, config, n_dev: int,
     if getattr(config, "device_memory_mb", 0):
         hbm_budget = config.device_memory_mb * 2 ** 20  # -ll:fsize analog
 
+    # per-iteration search telemetry: JSONL when --search-log is set, tracer
+    # events when tracing is on (reference analog: the exported-strategy
+    # workflow, but for the search's decision sequence itself)
+    from ..obs import SearchLog, get_tracer
+
+    tracer = get_tracer()
+    slog = SearchLog(getattr(config, "search_log_file", "") or None,
+                     kind="unity")
+
     def search_all(lam: float, mem_budget: Optional[int] = None
                    ) -> Optional[SearchResult]:
         """One sweep over factorizations at a fixed λ. With a memory budget,
         the best FEASIBLE candidate by time wins (falling back to minimum
         memory — reference: is_valid_strategy, graph.cc:1984-2032)."""
         results: List[SearchResult] = []
+        # per-sweep log state: `accepted` must mirror THIS sweep's actual
+        # selection rule (feasibility included) — a global best across λ
+        # sweeps would mislabel a sweep's real winner as rejected
+        sweep_best = [float("inf")]
         for dp, tp in factorizations(n_dev):
             if batch % dp != 0:
                 continue
@@ -1073,12 +1093,25 @@ def unity_search(pcg: PCG, config, n_dev: int,
                     budget=max(budget // 4, 4), alpha=alpha, space=space,
                     lam=lam, protected_guids=protected_guids,
                     split_threshold=getattr(config,
-                                            "base_optimize_threshold", 0))
+                                            "base_optimize_threshold", 0),
+                    search_log=slog)
                 _, mem = sim.simulate(g, a, s)
                 _log.info(
                     "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f -> %.3f ms, "
                     "%.1f MiB/chip", dp, tp, dp_dcn, tp_dcn, lam, t * 1e3,
                     mem / 2 ** 20)
+                feasible = mem_budget is None or mem <= mem_budget
+                accepted = feasible and t < sweep_best[0]
+                if accepted:
+                    sweep_best[0] = t
+                slog.log(event="candidate", dp=dp, tp=tp,
+                         dcn=[dp_dcn, tp_dcn], lam=round(lam, 4),
+                         cost_ms=round(t * 1e3, 4),
+                         mem_mib=round(mem / 2 ** 20, 1),
+                         feasible=bool(feasible), accepted=bool(accepted),
+                         best_ms=round(
+                             (sweep_best[0] if sweep_best[0] != float("inf")
+                              else t) * 1e3, 4))
                 results.append(SearchResult(
                     strategy=assignment_to_strategy(
                         g, a, s, dp, tp, machine=machine,
@@ -1090,13 +1123,21 @@ def unity_search(pcg: PCG, config, n_dev: int,
         if not results:
             return None
         if mem_budget is not None:
-            feasible = [r for r in results if r.sim_memory <= mem_budget]
-            if feasible:
-                return min(feasible, key=lambda r: r.sim_time)
-            return min(results, key=lambda r: r.sim_memory)
-        return min(results, key=lambda r: r.sim_time)
+            ok = [r for r in results if r.sim_memory <= mem_budget]
+            chosen = (min(ok, key=lambda r: r.sim_time) if ok
+                      else min(results, key=lambda r: r.sim_memory))
+        else:
+            chosen = min(results, key=lambda r: r.sim_time)
+        slog.log(event="sweep_result", lam=round(lam, 4),
+                 mesh=list(chosen.mesh_shape),
+                 cost_ms=round(chosen.sim_time * 1e3, 4),
+                 mem_mib=round(chosen.sim_memory / 2 ** 20, 1),
+                 feasible=bool(mem_budget is None
+                               or chosen.sim_memory <= mem_budget))
+        return chosen
 
-    with _log.scope("unity_search n_dev=%d" % n_dev):
+    with _log.scope("unity_search n_dev=%d" % n_dev), \
+            tracer.span("search", n_dev=n_dev):
         best = search_all(lam=1.0)
         # memory-aware λ binary search (reference: graph.cc:2060-2133):
         # find the largest λ (most runtime-weighted) whose best strategy
@@ -1143,9 +1184,19 @@ def unity_search(pcg: PCG, config, n_dev: int,
                                                    micro)
                 _log.info("pipeline pp=%d dp=%d m=%d -> %.3f ms, %.1f MiB",
                           pp, pdp, micro, t_pipe * 1e3, m_pipe / 2 ** 20)
-                if t_pipe < best.sim_time and (
-                        not config.perform_memory_search or
-                        m_pipe <= hbm_budget):
+                # accepted must mirror the ACTUAL decision below, memory
+                # budget included, or replaying the log reconstructs a
+                # different search than the one that ran
+                pipe_ok = t_pipe < best.sim_time and (
+                    not config.perform_memory_search or
+                    m_pipe <= hbm_budget)
+                slog.log(event="pipeline_candidate", pp=pp, dp=pdp,
+                         n_micro=micro, cost_ms=round(t_pipe * 1e3, 4),
+                         mem_mib=round(m_pipe / 2 ** 20, 1),
+                         accepted=bool(pipe_ok),
+                         best_ms=round((t_pipe if pipe_ok
+                                        else best.sim_time) * 1e3, 4))
+                if pipe_ok:
                     from ..parallel.strategy import data_parallel_strategy
 
                     strat = data_parallel_strategy(pcg, n_dev)
@@ -1155,6 +1206,14 @@ def unity_search(pcg: PCG, config, n_dev: int,
                         sim_memory=m_pipe, mesh_shape=(n_dev, 1),
                         pcg=None, states=None)
 
+    if best is not None:
+        slog.log(event="result", cost_ms=round(best.sim_time * 1e3, 4),
+                 mem_mib=round(best.sim_memory / 2 ** 20, 1),
+                 mesh=list(best.mesh_shape),
+                 pipeline=(list(best.strategy.pipeline)
+                           if getattr(best.strategy, "pipeline", None)
+                           else None))
+    slog.close()
     if best is None:
         from ..parallel.strategy import data_parallel_strategy
 
@@ -1218,6 +1277,10 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
     # (dp, tp), and the final strategy must be built around the mesh the
     # best assignment was actually found under
     best, best_t, best_fact = dict(current), cur_t, (dp, tp)
+    from ..obs import SearchLog
+
+    slog = SearchLog(getattr(config, "search_log_file", "") or None,
+                     kind="mcmc")
     for it in range(iterations):
         # occasionally rewrite the mesh factorization (reference: restart)
         if it % 100 == 99 and len(facts) > 1:
@@ -1234,10 +1297,18 @@ def mcmc_optimize(pcg: PCG, config, n_dev: int,
         cand[node.guid] = OpSharding(dp=dp, tp=tp if kind != "none" else 1,
                                      kind=kind)
         t = simulate_best(sim, pcg, cand, {})
-        if t < cur_t or rng.random() < math.exp(-(t - cur_t) / temperature):
+        accepted = (t < cur_t
+                    or rng.random() < math.exp(-(t - cur_t) / temperature))
+        slog.log(event="mcmc", cost_ms=round(t * 1e3, 4),
+                 accepted=bool(accepted), temperature=temperature,
+                 dp=dp, tp=tp, best_ms=round(min(t, best_t) * 1e3, 4))
+        if accepted:
             current, cur_t = cand, t
             if t < best_t:
                 best, best_t, best_fact = dict(cand), t, (dp, tp)
+    slog.log(event="result", cost_ms=round(best_t * 1e3, 4),
+             mesh=list(best_fact))
+    slog.close()
     states = {n.guid: "R" for n in nodes}
     return assignment_to_strategy(pcg, best, states, *best_fact,
                                   machine=machine)

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Summarize a flexflow_tpu obs artifact: top-N phase time table.
+
+Consumes any of the subsystem's outputs and prints where the time (or the
+search's attention) went, so BENCH rounds can diff phase breakdowns between
+PRs without loading Perfetto:
+
+* Chrome trace-event JSON (``--trace-file`` / ``Tracer.write``): aggregates
+  complete ('X') spans by name — count, total/mean/max wall.
+* telemetry JSON (``--telemetry-file`` / ``StepTelemetry.write``): step
+  count, compile-vs-steady split, samples/sec, MFU, memory.
+* search JSONL (``--search-log`` / ``SearchLog``, also the tracer's JSONL
+  event sink): iterations, accept rate, best-so-far cost trajectory.
+
+Usage: python scripts/trace_summary.py FILE [-n TOP]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str):
+    """Returns ("trace"|"telemetry"|"jsonl", payload)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            try:
+                data = json.load(f)
+            except json.JSONDecodeError:
+                f.seek(0)
+                return "jsonl", _load_jsonl(f)
+            if "traceEvents" in data:
+                return "trace", data
+            if "steps" in data or "loss_history" in data \
+                    or "phase" in data:
+                return "telemetry", data
+            # a single-line JSONL file (one-iteration search log, tail
+            # fragment) also parses as one JSON object — route by shape
+            return "jsonl", [data]
+        return "jsonl", _load_jsonl(f)
+
+
+def _load_jsonl(f):
+    records = []
+    for line in f:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return records
+
+
+def _fmt_ms(us: float) -> str:
+    return f"{us / 1e3:10.3f}"
+
+
+def summarize_trace(data, top: int) -> None:
+    spans = {}
+    counters = {}
+    n_instant = 0
+    for ev in data.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "X":
+            s = spans.setdefault(ev["name"], [0, 0.0, 0.0])
+            s[0] += 1
+            s[1] += ev.get("dur", 0.0)
+            s[2] = max(s[2], ev.get("dur", 0.0))
+        elif ph == "C":
+            counters[ev["name"]] = ev.get("args", {})
+        elif ph == "i":
+            n_instant += 1
+    rows = sorted(spans.items(), key=lambda kv: -kv[1][1])[:top]
+    print(f"{'phase':24s} {'count':>6s} {'total_ms':>10s} "
+          f"{'mean_ms':>10s} {'max_ms':>10s}")
+    for name, (cnt, tot, mx) in rows:
+        print(f"{name:24s} {cnt:6d} {_fmt_ms(tot)} "
+              f"{_fmt_ms(tot / cnt)} {_fmt_ms(mx)}")
+    if counters:
+        print("\ncounters (last value):")
+        for name, args in counters.items():
+            print(f"  {name} = {args.get(name, args)}")
+    if n_instant:
+        print(f"\n{n_instant} instant events (not aggregated)")
+
+
+def summarize_telemetry(data, top: int) -> None:
+    if "epochs" in data:  # keras TelemetryCallback: one summary per epoch
+        eps = data["epochs"]
+        print(f"telemetry with {len(eps)} epoch records; last epoch:")
+        if eps:
+            summarize_telemetry(eps[-1], top)
+        return
+    print(f"phase: {data.get('phase')}  steps: {data.get('steps')}  "
+          f"batch_size: {data.get('batch_size')}")
+    if "first_step_s" in data:
+        line = f"first step (jit compile): {data['first_step_s'] * 1e3:.1f} ms"
+        if "steady_step_s" in data:
+            line += (f"   steady step: {data['steady_step_s'] * 1e3:.3f} ms"
+                     f"   compile overhead: "
+                     f"{data.get('compile_overhead_s', 0) * 1e3:.1f} ms")
+        print(line)
+    if "samples_per_sec" in data:
+        print(f"throughput: {data['samples_per_sec']} samples/s")
+    if "estimated_mfu" in data:
+        print(f"estimated MFU: {data['estimated_mfu']}")
+    mem = data.get("device_memory")
+    if mem:
+        peak = mem.get("peak_memory_in_bytes")
+        if peak:
+            print(f"XLA peak memory: {peak / 2 ** 20:.1f} MiB")
+    losses = data.get("loss_history", [])
+    if losses:
+        show = losses[:top]
+        print(f"loss: first {len(show)} of {len(losses)}: "
+              + ", ".join(f"{v:.4f}" for v in show)
+              + (f" ... final {losses[-1]:.4f}" if len(losses) > top else ""))
+
+
+def summarize_jsonl(records, top: int) -> None:
+    # search logs carry cost_ms; generic event sinks aggregate by name.
+    # "result"/"sweep_result" records are summaries, not iterations — keep
+    # them out of the iteration count / accept rate / trajectory
+    iters = [r for r in records
+             if "cost_ms" in r
+             and r.get("event") not in ("result", "sweep_result")]
+    if iters:
+        kinds = {r.get("search", r.get("event", "?")) for r in iters}
+        accepted = sum(1 for r in iters if r.get("accepted"))
+        best = min(r["cost_ms"] for r in iters)
+        print(f"search log ({'/'.join(sorted(kinds))}): "
+              f"{len(iters)} iterations, {accepted} accepted "
+              f"({accepted / len(iters) * 100:.1f}%)")
+        print(f"best candidate cost: {best:.4f} ms")
+        final = [r for r in records if r.get("event") == "result"]
+        if final:
+            print(f"result: {json.dumps(final[-1])}")
+        print("\nbest-so-far trajectory (every ~N/10 iterations):")
+        stride = max(len(iters) // 10, 1)
+        for r in iters[::stride]:
+            print(f"  iter {r.get('iter', '?'):>5}: "
+                  f"cost {r['cost_ms']:10.4f} ms  "
+                  f"best {r.get('best_ms', r['cost_ms']):10.4f} ms  "
+                  f"{'accept' if r.get('accepted') else 'reject'}")
+        return
+    by_name = {}
+    for r in records:
+        by_name[r.get("name", r.get("event", "?"))] = \
+            by_name.get(r.get("name", r.get("event", "?")), 0) + 1
+    print(f"{'event':32s} {'count':>8s}")
+    for name, cnt in sorted(by_name.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{name:32s} {cnt:8d}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("file", help="trace JSON / telemetry JSON / JSONL log")
+    ap.add_argument("-n", "--top", type=int, default=20,
+                    help="rows to show (default 20)")
+    args = ap.parse_args(argv)
+    kind, payload = load(args.file)
+    if kind == "trace":
+        summarize_trace(payload, args.top)
+    elif kind == "telemetry":
+        summarize_telemetry(payload, args.top)
+    else:
+        summarize_jsonl(payload, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,8 +122,12 @@ def test_memory_model_within_2x_of_xla_peak():
     yd = jax.device_put(y, ff.executor.batch_sharding(2))
     ma = ff.executor.train_step_memory_analysis(ff.params, ff.opt_state,
                                                 xd, yd)
-    xla_peak = int(ma.peak_memory_in_bytes)
-    assert xla_peak > 0
+    # version-compat accessor: older jaxlibs don't expose
+    # peak_memory_in_bytes and need the component-sum reconstruction
+    from flexflow_tpu.obs.telemetry import peak_memory_bytes
+
+    xla_peak = peak_memory_bytes(ma)
+    assert xla_peak and xla_peak > 0
     ratio = mem_analytic / xla_peak
     assert 0.5 <= ratio <= 2.5, (mem_analytic, xla_peak, ratio)
     # feasibility is conservative: if the analytic model accepts a
@@ -162,9 +166,12 @@ def test_memory_lambda_feasible_against_xla():
     yd = jax.device_put(yv, ff.executor.batch_sharding(1))
     ma = ff.executor.train_step_memory_analysis(ff.params, ff.opt_state,
                                                 xd, yd)
-    assert int(ma.peak_memory_in_bytes) <= budget_mb * 2 ** 20, \
+    from flexflow_tpu.obs.telemetry import peak_memory_bytes
+
+    xla_peak = peak_memory_bytes(ma)
+    assert xla_peak and xla_peak <= budget_mb * 2 ** 20, \
         f"λ-accepted strategy exceeds budget by XLA's own count: " \
-        f"{ma.peak_memory_in_bytes / 2 ** 20:.1f} MiB"
+        f"{(xla_peak or 0) / 2 ** 20:.1f} MiB"
 
 
 def test_ici_ring_skips_degenerate_axes():

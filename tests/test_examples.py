@@ -6,6 +6,11 @@ import sys
 
 import pytest
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite / nightly (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples", "python")
 

@@ -15,6 +15,11 @@ import pytest
 
 import flexflow_tpu.kernels.flash_attention  # noqa: F401  (module import)
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite / nightly (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 fa = sys.modules["flexflow_tpu.kernels.flash_attention"]
 
 

@@ -6,6 +6,11 @@ import pytest
 
 from flexflow_tpu import FFConfig, FFModel, LossType
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite / nightly (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 
 def test_keras_callbacks_scheduler_and_verify():
     from flexflow_tpu.frontends import keras as K

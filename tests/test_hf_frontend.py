@@ -12,6 +12,11 @@ from flexflow_tpu import DataType, FFConfig, FFModel, LossType  # noqa: E402
 from flexflow_tpu.frontends.torch_fx import (PyTorchModel,  # noqa: E402
                                              copy_torch_weights)
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 
 @pytest.fixture(scope="module")
 def tiny_bert():

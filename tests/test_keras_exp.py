@@ -16,6 +16,11 @@ import pytest
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.ffconst import OperatorType
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite / nightly (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 
 class _Tensor:
     def __init__(self, shape):

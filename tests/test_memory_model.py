@@ -21,6 +21,11 @@ from flexflow_tpu.models.bert import BertConfig, build_bert
 from flexflow_tpu.search.machine_model import TPUMachineModel
 from flexflow_tpu.search.simulator import OpSharding, Simulator
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite / nightly (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 # XLA peak_memory_in_bytes, measured on v5e (2026-07, jax 0.9/libtpu of this
 # image) for the exact configs built below
 XLA_PEAK_MB = {

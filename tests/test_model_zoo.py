@@ -9,6 +9,11 @@ from flexflow_tpu.models import (NMTConfig, build_candle_uno,
                                  build_inception_v3, build_mlp_unify,
                                  build_nmt, build_resnext50, build_xdl)
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 
 def _config(bs):
     c = FFConfig()

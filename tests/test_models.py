@@ -9,6 +9,11 @@ from flexflow_tpu.models import (TransformerConfig, build_alexnet_cifar10,
                                  build_dlrm, build_moe_mlp, build_resnet50,
                                  build_transformer)
 
+# heavyweight tier: excluded from the fast tier-1 gate (-m 'not slow');
+# still runs in the full suite (see pyproject [tool.pytest.ini_options])
+pytestmark = pytest.mark.slow
+
+
 
 def _fit_steps(ff, xs, y, loss=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                epochs=1):
