@@ -23,6 +23,14 @@ Backward has two schedules:
   dkv and dq kernels, each O(block) VMEM, for sequences whose Q residency
   would not fit VMEM.
 
+Numerics note: q is PRE-SCALED by 1/sqrt(d) outside the kernels (XLA fuses
+the multiply into the producing projection). The fold is bit-exact in bf16
+only when the scale is a power of two (d = 4^k, e.g. d=64/256); at d=128 and
+d=192 — both admitted by the d % 64 == 0 flash gate — each q element takes
+one extra bf16 rounding versus scaling the f32 score tile in-kernel. The
+error is bounded by one bf16 ulp per element ahead of the f32 accumulation
+and sits inside the parity tests' bf16 tolerances; see _flash_forward.
+
 Falls back transparently to the einsum core off-TPU (interpret mode is used in
 tests)."""
 from __future__ import annotations
@@ -255,7 +263,13 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     seq_k = k.shape[2]
     # pre-scale q outside the kernel: XLA fuses the multiply into the
     # producing projection, and the per-score-element sm_scale VPU pass
-    # disappears from the kernel (exact for d = 4^k, e.g. 1/8 at d=64)
+    # disappears from the kernel. Exact when 1/sqrt(d) is a power of two
+    # (d = 4^k: 1/8 at d=64, 1/16 at d=256). For d=128 (1/(8*sqrt(2))) and
+    # d=192 the scale is NOT a power of two, so rounding the scaled q back
+    # to bf16 costs ONE extra bf16 rounding per q element versus applying
+    # sm_scale to the f32 score tile in-kernel — bounded by bf16 eps
+    # (~0.4%) per element, before the f32 accumulation; the parity tests'
+    # bf16 tolerances cover it.
     q = (q * np.float32(1.0 / np.sqrt(d))).astype(q.dtype)
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)

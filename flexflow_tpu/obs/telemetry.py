@@ -220,6 +220,11 @@ class SearchLog:
         self.path = path
         self.kind = kind
         self.iterations = 0
+        # per-event-type record counts (e.g. "candidate", "xfer",
+        # "pipeline_candidate"): unity_search derives its candidates/sec
+        # metric from these, so the rate in the final record always matches
+        # what the log actually streamed
+        self.counts: Dict[str, int] = {}
         self._fh = None  # set BEFORE open(): __del__ must find the attr
         # even when open() raises on a bad path
         if path:
@@ -229,6 +234,9 @@ class SearchLog:
 
     def log(self, **rec) -> None:
         self.iterations += 1
+        ev = rec.get("event")
+        if ev:
+            self.counts[ev] = self.counts.get(ev, 0) + 1
         rec.setdefault("search", self.kind)
         rec.setdefault("iter", self.iterations)
         if self._fh is not None:

@@ -134,8 +134,12 @@ class MultiHeadAttentionOp(Op):
             out = flash_attention(q, k, v, causal, bq, bk,
                                   dropout=live_dropout, seed=seed)
         else:
-            out = mha_core(q, k, v, causal=causal, dropout=dropout,
-                           rng=ctx.rng, training=ctx.training)
+            # the already-resolved live_dropout is the single gate (the r5
+            # warning path); rng only rides along when dropout is live, so
+            # _resolve_live_dropout cannot be second-guessed downstream
+            out = mha_core(q, k, v, causal=causal, dropout=live_dropout,
+                           rng=ctx.rng if live_dropout else None,
+                           training=ctx.training)
         y = jnp.einsum("bhsv,hvd->bsd", out, params["wo"],
                        preferred_element_type=jnp.float32).astype(q_in.dtype)
         if "bo" in params:
@@ -213,17 +217,35 @@ _tuning_cache = {}
 def _flash_tuning() -> dict:
     """The FLASH_TUNING row for the current chip (device_kind normalized by
     machine_model.detect_generation — the one shared matcher; v5e's
-    measured row is the default for unknown kinds)."""
+    measured row is the default for unknown kinds). When an UNMEASURED TPU
+    generation inherits the v5e row, warn once: if flash kernels regress
+    on that chip, the trace must point at the tuning table, not the
+    kernels (ADVICE r5)."""
     if "row" not in _tuning_cache:
         gen = None
+        on_tpu = False
         try:
             import jax
 
             from ..search.machine_model import detect_generation
 
-            gen = detect_generation(jax.devices()[0].device_kind)
+            dev = jax.devices()[0]
+            on_tpu = dev.platform == "tpu"
+            gen = detect_generation(dev.device_kind)
         except Exception:
             pass
+        if on_tpu and gen not in FLASH_TUNING:
+            import warnings
+
+            warnings.warn(
+                f"flash-attention tile table has no MEASURED row for TPU "
+                f"generation {gen!r}; inheriting the v5e tiling (block_q "
+                f"{FLASH_TUNING['v5e']['block_q_cap']} / block_k "
+                f"{FLASH_TUNING['v5e']['block_k_cap']} / min_block "
+                f"{FLASH_TUNING['v5e']['min_block']}) as an unmeasured "
+                f"estimate — on-chip regressions are traceable here; "
+                f"re-measure per the FLASH_TUNING recipe and add a row.",
+                stacklevel=2)
         _tuning_cache["row"] = FLASH_TUNING.get(gen, FLASH_TUNING["v5e"])
     return _tuning_cache["row"]
 
@@ -304,9 +326,11 @@ class SDPAOp(Op):
             seed = _dropout_seed(ctx.rng) if live_dropout else None
             return [flash_attention(q, k, v, causal, bq, bk,
                                     dropout=live_dropout, seed=seed)]
-        return [mha_core(q, k, v, causal=causal,
-                         dropout=self.attrs.get("dropout", 0.0),
-                         rng=ctx.rng, training=ctx.training,
+        # same single-gate rule as MultiHeadAttentionOp: pass the resolved
+        # live_dropout, rng only when it is live
+        return [mha_core(q, k, v, causal=causal, dropout=live_dropout,
+                         rng=ctx.rng if live_dropout else None,
+                         training=ctx.training,
                          attn_mask=mask, scale=self.attrs.get("scale"))]
 
     def flops(self, input_shapes, output_shapes):

@@ -15,11 +15,22 @@ tasks). TPU-native differences (SURVEY §7 hard-part 3):
 * Optional measured mode (``measure_operator_cost``) jit-times a single op
   standalone on the real chip and caches by (op params, sharding), mirroring
   the reference's cache keyed by op + MachineView.
+* Delta-cost engine (ISSUE 2): ``op_cost`` and the DP search's per-node
+  option tables are memoized in bounded LRUs keyed by
+  (op params, in-shapes, sharding, dcn), persisting across factorization
+  sweeps, λ iterations and rewrite candidates — the TPU analog of the
+  reference re-simulating only *deltas* (simulator.cc's cached task costs).
+  Calibration and memory-model knob changes flush the tables; the
+  ``FLEXFLOW_TPU_SEARCH_SELFCHECK`` env var enables a test-only gate that
+  re-derives every hit and asserts equality. See ``docs/search.md``.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+import math
+import os
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -136,12 +147,83 @@ def sequence_schedule(node: PCGNode, in_shapes, sh: "OpSharding",
     return "ring", ring_t
 
 
+# test-only equivalence gate for the delta-cost engine: when set, every
+# cache hit is re-derived from scratch and compared, and the incremental DP
+# in unity.best_first_optimize is shadowed by a full re-cost — identical
+# chosen strategies and costs (within float tolerance) are asserted.
+SELFCHECK_ENV = "FLEXFLOW_TPU_SEARCH_SELFCHECK"
+
+
+def selfcheck_enabled() -> bool:
+    return bool(os.environ.get(SELFCHECK_ENV))
+
+
+def _assert_cost_close(fresh: "CostMetrics", cached: "CostMetrics",
+                       key: Tuple) -> None:
+    for f in dataclasses.fields(CostMetrics):
+        a = getattr(fresh, f.name)
+        b = getattr(cached, f.name)
+        if not math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-12):
+            raise AssertionError(
+                f"delta-cost selfcheck: cached {f.name}={b!r} != "
+                f"fresh {a!r} for key {key!r} — a cost knob changed "
+                f"without invalidate_cost_tables()")
+
+
+_KNOB_UNSET = object()
+
+
+def _cost_knob(name: str, doc: str = ""):
+    """A Simulator attribute that parameterizes memoized costs: setting it
+    to a NEW value flushes the delta-cost tables, so stale entries priced
+    under the old calibration/memory model can never be served."""
+    attr = "_knob_" + name
+
+    def fget(self):
+        return getattr(self, attr)
+
+    def fset(self, value):
+        old = getattr(self, attr, _KNOB_UNSET)
+        setattr(self, attr, value)
+        if old is not _KNOB_UNSET and old != value:
+            self.invalidate_cost_tables()
+
+    return property(fget, fset, doc=doc)
+
+
 class Simulator:
+    # cost knobs: every memoized (time, mem, comm) entry is a function of
+    # these, so assignment auto-flushes the caches (delta-cost engine)
+    calibration = _cost_knob(
+        "calibration", "global measured/analytical scale factor")
+    update_bytes_factor = _cost_knob("update_bytes_factor")
+    op_overhead = _cost_knob("op_overhead")
+    opt_state_words = _cost_knob("opt_state_words")
+    activation_el = _cost_knob(
+        "activation_el", "bytes per saved-activation element (compute dtype)")
+
     def __init__(self, machine: TPUMachineModel,
-                 overlap_backward_update: bool = False):
+                 overlap_backward_update: bool = False,
+                 cost_cache_size: int = 1 << 17):
         self.machine = machine
         self.overlap = overlap_backward_update
         self._measure_cache: Dict[Tuple, float] = {}
+        # ---- delta-cost engine (reference: simulator.cc's cached task
+        # costs making delta re-simulation tractable). Bounded LRUs keyed by
+        # (op params key, in-shapes, sharding, dcn): entries persist across
+        # factorization sweeps, λ iterations and rewrite candidates; the
+        # dcn topology is part of the key (set_axis_topology never serves a
+        # stale entry), while calibration/knob changes flush everything via
+        # invalidate_cost_tables(). cost_cache_size <= 0 disables caching
+        # (full re-costing — the equivalence baseline in tests).
+        self.cost_cache_size = cost_cache_size
+        self._cost_cache: "OrderedDict[Tuple, CostMetrics]" = OrderedDict()
+        self._table_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._reshard_cache: "OrderedDict[Tuple, float]" = OrderedDict()
+        self.cost_cache_hits = 0
+        self.cost_cache_misses = 0
+        self.table_hits = 0
+        self.table_misses = 0
         self.calibration = 1.0  # global measured/analytical scale factor
         # per-op-key measured/analytical ratios (reference: the per-(op,view)
         # cost cache of simulator.cc:489; here per op-shape, scaled
@@ -220,9 +302,77 @@ class Simulator:
         groups contending for the host's DCN bandwidth."""
         return max(self.machine.chips_per_host // max(group_ici, 1), 1)
 
+    # ------------------------------------------------- delta-cost cache API
+    def invalidate_cost_tables(self) -> None:
+        """Flush every memoized cost: the op-cost LRU, the per-node DP
+        option tables (unity._node_cost_entries), and the resharding memo.
+        Called automatically when a cost knob changes and by the
+        calibration paths — cached entries priced under stale calibration
+        would silently re-rank candidates otherwise."""
+        self._cost_cache.clear()
+        self._table_cache.clear()
+        self._reshard_cache.clear()
+
+    def table_get(self, key: Tuple):
+        """Look up an opaque per-node cost table (the DP search's per-node
+        option entries) in the bounded LRU; None on miss."""
+        v = self._table_cache.get(key)
+        if v is None:
+            self.table_misses += 1
+            return None
+        self._table_cache.move_to_end(key)
+        self.table_hits += 1
+        return v
+
+    def table_put(self, key: Tuple, value) -> None:
+        if self.cost_cache_size <= 0:
+            return
+        self._table_cache[key] = value
+        if len(self._table_cache) > self.cost_cache_size:
+            self._table_cache.popitem(last=False)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """Hit/miss counters for the SearchLog/tracer and bench.py."""
+        total = self.cost_cache_hits + self.cost_cache_misses
+        return {
+            "cost_cache_hits": self.cost_cache_hits,
+            "cost_cache_misses": self.cost_cache_misses,
+            "cost_cache_hit_rate": round(self.cost_cache_hits / total, 4)
+            if total else 0.0,
+            "table_hits": self.table_hits,
+            "table_misses": self.table_misses,
+        }
+
     # ------------------------------------------------------------ per-op cost
     def op_cost(self, node: PCGNode, in_shapes: List[Tuple[int, ...]],
                 sh: OpSharding) -> CostMetrics:
+        """Memoized per-op cost: (op params key, in-shapes, sharding, dcn)
+        → CostMetrics, held in a bounded LRU that persists across
+        factorizations, λ iterations and rewrite candidates (the delta-cost
+        engine's ground layer; reference: measure_operator_cost's per-
+        (op, MachineView) cache, simulator.cc:489). The returned
+        CostMetrics is shared — callers must not mutate it."""
+        key = (node.op.params_key(), tuple(map(tuple, in_shapes)), sh,
+               self.dp_dcn, self.tp_dcn)
+        cached = self._cost_cache.get(key)
+        if cached is not None:
+            self._cost_cache.move_to_end(key)
+            self.cost_cache_hits += 1
+            if selfcheck_enabled():
+                _assert_cost_close(
+                    self._op_cost_uncached(node, in_shapes, sh), cached, key)
+            return cached
+        self.cost_cache_misses += 1
+        cm = self._op_cost_uncached(node, in_shapes, sh)
+        if self.cost_cache_size > 0:
+            self._cost_cache[key] = cm
+            if len(self._cost_cache) > self.cost_cache_size:
+                self._cost_cache.popitem(last=False)
+        return cm
+
+    def _op_cost_uncached(self, node: PCGNode,
+                          in_shapes: List[Tuple[int, ...]],
+                          sh: OpSharding) -> CostMetrics:
         m = self.machine
         op = node.op
         out_shapes = node.out_shapes
@@ -347,17 +497,28 @@ class Simulator:
         """
         if src_state == dst_state or tp <= 1:
             return 0.0
+        key = (bytes_total, src_state, dst_state, dp, tp, self.tp_dcn)
+        cached = self._reshard_cache.get(key)
+        if cached is not None:
+            self._reshard_cache.move_to_end(key)
+            return cached
         per_chip = bytes_total // max(dp * tp, 1)
         tp_dcn = self.tp_dcn if tp % self.tp_dcn == 0 else 1
         tp_ici = max(tp // tp_dcn, 1)
         sharers = self._nic_sharers(tp_ici)
         if dst_state == "R":
-            return self.machine.hier_allgather_time(per_chip, tp_ici, tp_dcn,
+            cost = self.machine.hier_allgather_time(per_chip, tp_ici, tp_dcn,
                                                     nic_sharers=sharers)
-        if src_state == "R":
-            return 0.0  # R->S / R->Q: local slice
-        return self.machine.hier_alltoall_time(per_chip, tp_ici, tp_dcn,
-                                               nic_sharers=sharers)  # S<->Q
+        elif src_state == "R":
+            cost = 0.0  # R->S / R->Q: local slice
+        else:  # S<->Q
+            cost = self.machine.hier_alltoall_time(per_chip, tp_ici, tp_dcn,
+                                                   nic_sharers=sharers)
+        if self.cost_cache_size > 0:
+            self._reshard_cache[key] = cost
+            if len(self._reshard_cache) > self.cost_cache_size:
+                self._reshard_cache.popitem(last=False)
+        return cost
 
     # ------------------------------------------------------- whole-graph sim
     def simulate(self, pcg: PCG,
@@ -532,6 +693,9 @@ class Simulator:
 
         Also records the compute dtype's element size for the peak-memory
         model (saved activations live in the compute dtype)."""
+        # flush the delta-cost tables on both sides of calibration: entries
+        # priced before the per-key ratios land are stale the moment they do
+        self.invalidate_cost_tables()
         if compute_dtype is not None:
             import jax.numpy as jnp
 
@@ -590,6 +754,7 @@ class Simulator:
                     # noisy micro-measurement cannot distort the ranking
                     self._key_bwd_ratio[key] = min(
                         max((tg - t) / t, 0.25), 4.0)
+        self.invalidate_cost_tables()
         return measured
 
     def measure_operator_cost(self, node: PCGNode,

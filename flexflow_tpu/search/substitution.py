@@ -158,7 +158,8 @@ class GraphXfer:
                 out.append(m)
         return out
 
-    def apply(self, pcg: PCG, match: Dict[int, int]) -> PCG:
+    def apply(self, pcg: PCG, match: Dict[int, int],
+              return_touched: bool = False):
         """Apply the rewrite on a copy of ``pcg`` (reference:
         GraphXfer::run, substitution.cc — create_new_operator + rewire).
 
@@ -167,7 +168,13 @@ class GraphXfer:
         input slots bind to the matched nodes' actual producers. The new op's
         attrs come from ``attrs_from`` (see OpX) so shape-bearing parameters
         (out_dim, num_heads, ...) carry over. Shapes must be preserved by the
-        rule — verified, ValueError otherwise."""
+        rule — verified, ValueError otherwise.
+
+        With ``return_touched`` the result is ``(graph, touched_guids)``
+        where ``touched_guids`` are the newly created nodes — the seed of
+        the delta-cost engine's dirty set (best_first_optimize re-costs
+        only them plus their descendants; the matched nodes are deleted, and
+        every rewired consumer is a descendant of a touched node)."""
         from ..ops.base import op_class_for
 
         g = pcg.copy()
@@ -233,6 +240,8 @@ class GraphXfer:
             del g.nodes[guid]
             g._order.remove(guid)
         g.retopo()
+        if return_touched:
+            return g, tuple(n.guid for n in new_nodes)
         return g
 
 
@@ -248,8 +257,20 @@ def load_substitution_json(path: str) -> List[GraphXfer]:
     xfers: List[GraphXfer] = []
     for rule in rules:
         try:
-            src = _parse_ops(rule.get("srcOp", []))
-            dst = _parse_ops(rule.get("dstOp", []), dst=True)
+            src_json = rule.get("srcOp", [])
+            src = _parse_ops(src_json)
+            # first same-type src op's raw PM params — the template a dst op
+            # inherits its attrs from (OpX.attrs_from default). Dropping a
+            # dst-side PM_* key is only sound when it RESTATES the
+            # template's value; _parse_ops rejects the rule otherwise.
+            src_pm: Dict[OperatorType, Dict[str, Any]] = {}
+            for op in src_json:
+                t = _NAME_TO_OP.get(op.get("type"))
+                if t is not None and t not in src_pm:
+                    src_pm[t] = {str(p["key"]): p["value"]
+                                 for p in op.get("para", [])
+                                 if "key" in p and "value" in p}
+            dst = _parse_ops(rule.get("dstOp", []), dst=True, src_pm=src_pm)
         except KeyError:
             continue
         if src:
@@ -266,11 +287,24 @@ _TASO_ACTI = {0: None, 1: "AC_MODE_SIGMOID", 2: "AC_MODE_RELU",
               3: "AC_MODE_TANH"}
 
 
-def _parse_ops(ops_json, dst: bool = False) -> List[OpX]:
+# PM_* keys that are fully enforced by the pattern structure and apply()'s
+# hard output-shape check: op type comes from the record's "type", arity
+# from the pattern edges, dim counts from shape inference — dropping them
+# loses nothing on either side
+_PM_SHAPE_ENFORCED = {"PM_OP_TYPE", "PM_NUMDIM", "PM_NUM_INPUTS",
+                      "PM_NUM_OUTPUTS"}
+
+
+def _parse_ops(ops_json, dst: bool = False,
+               src_pm: Optional[Dict[OperatorType, Dict[str, Any]]] = None
+               ) -> List[OpX]:
     """``dst=False``: parameters become match CONSTRAINTS on the src
     pattern. ``dst=True``: they become attr OVERRIDES on the new ops —
     apply() reads only attr_overrides, so dst-side attributes fed into
-    constraints would be silently ignored (r5 review)."""
+    constraints would be silently ignored (r5 review). ``src_pm`` (dst side
+    only) maps each src op type to its first src op's raw PM params: a dst
+    op inherits its attrs from that matched node's template, so a dst-side
+    PM_* key may be dropped only when it restates the template's value."""
     from ..ffconst import ActiMode
 
     out = []
@@ -303,11 +337,31 @@ def _parse_ops(ops_json, dst: bool = False) -> List[OpX]:
                     (None, ActiMode.AC_MODE_NONE)
                     if name is None else mode)
             elif key.startswith("PM_"):
-                # structural parameters (PM_NUMDIM, PM_NUM_INPUTS, PM_AXIS,
-                # PM_PARALLEL_*) are either enforced by the pattern edges
-                # already or use the reference's reversed-dims indexing —
-                # dropping them widens matching, and soundness is kept by
-                # apply()'s hard output-shape check plus the cost gate
+                if dst and key not in _PM_SHAPE_ENFORCED:
+                    # semantics-bearing override (PM_AXIS, PM_PERM,
+                    # PM_PARALLEL_*, ... — untranslated here: the reference
+                    # stores them with reversed-dims indexing). Dropping it
+                    # is sound ONLY when a same-type src template exists
+                    # AND restates the same value — then the new op
+                    # inherits the matched node's real attr. With no
+                    # template the op would be built with DEFAULT attrs;
+                    # with a DIFFERING value the rule deliberately changes
+                    # the attr (e.g. a new transpose perm) and inheritance
+                    # would apply the old one — either way a
+                    # shape-preserving mismatch (square dims, equal-size
+                    # axes) could slip a semantically wrong rewrite past
+                    # the cost gate. Reject the rule like an unknown
+                    # PM_ACTI (ADVICE r5) instead of silently dropping.
+                    tpl = None if src_pm is None else \
+                        src_pm.get(_NAME_TO_OP[tname])
+                    if tpl is None or key not in tpl or tpl[key] != val:
+                        raise KeyError(f"{key}={val}")
+                # src-side constraints and template-restated dst keys:
+                # shape-enforced keys (PM_NUMDIM, PM_NUM_INPUTS, ...) are
+                # re-checked structurally; the dims-indexed ones use the
+                # reference's reversed-dims indexing, so dropping them only
+                # widens matching — soundness is kept by apply()'s hard
+                # output-shape check plus the cost gate
                 continue
             else:
                 attrs[key] = val

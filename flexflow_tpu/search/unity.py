@@ -41,6 +41,7 @@ import heapq
 import itertools
 import math
 import random
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -51,7 +52,7 @@ from ..parallel.pcg import PCG, PCGNode
 from ..parallel.strategy import NodeStrategy, Strategy
 from ..utils.recursive_logger import RecursiveLogger
 from .machine_model import TPUMachineModel
-from .simulator import OpSharding, Simulator
+from .simulator import OpSharding, Simulator, selfcheck_enabled
 
 _log = RecursiveLogger("unity")
 
@@ -109,6 +110,12 @@ class SearchResult:
     # (dp_dcn, tp_dcn): the DCN-spanning subfactor of each mesh axis on a
     # multi-host machine ((1, 1) = single slice)
     dcn: Tuple[int, int] = (1, 1)
+    # delta-cost engine telemetry, filled by unity_search: total search wall
+    # seconds, number of costed candidates, and the Simulator's cache
+    # hit/miss counters (bench.py's search_wall_s / search_candidates_per_s)
+    search_wall_s: Optional[float] = None
+    candidates: int = 0
+    cache_stats: Optional[Dict] = None
 
 
 def dcn_placements(dp: int, tp: int, num_hosts: int
@@ -232,6 +239,50 @@ def node_options(node: PCGNode, tp: int,
     return opts
 
 
+def _space_key(space: Optional[SearchSpace]) -> Tuple[bool, bool, bool, bool]:
+    space = space or SearchSpace.full()
+    return (space.parameter, space.attribute, space.sequence, space.expert)
+
+
+def _node_cost_entries(sim: Simulator, node: PCGNode,
+                       in_shapes: List[Tuple[int, ...]], dp: int, tp: int,
+                       space: Optional[SearchSpace]):
+    """Materialize the per-node cost table the DP mixes over: one entry
+    ``(kind, in_state, out_state, time_s, resident_mem_bytes)`` per valid
+    sharding option, plus the unsharded fallback row. Held in the
+    Simulator's bounded LRU keyed by (op params key, in-shapes, dp, tp,
+    dcn, search-space) — guid-independent, so the 24 identical BERT layers
+    share one entry and the table survives factorization sweeps, λ
+    iterations and rewrite candidates (the delta-cost engine's unit of
+    reuse; reference analog: simulator.cc's cached task costs)."""
+    key = ("dp_table", node.op.params_key(), tuple(map(tuple, in_shapes)),
+           dp, tp, sim.dp_dcn, sim.tp_dcn, _space_key(space))
+    hit = sim.table_get(key)
+    if hit is not None:
+        return hit
+    entries = []
+    for kind, in_state, out_state in node_options(node, tp, in_shapes, space):
+        eff_tp = tp if kind != "none" else 1
+        act_tp = tp if (kind == "none"
+                        and out_state in ("S", "Q", "H")) else 1
+        sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp)
+        cm = sim.op_cost(node, in_shapes, sh)
+        # liveness-aware per-node resident memory — the same per-node
+        # formula Simulator.simulate's peak sums; the DP objective is a
+        # LOWER bound on the full peak (the global transient max-term
+        # cannot decompose per node) and the λ loop's accept/reject uses
+        # the full simulate() model, which includes it
+        entries.append((kind, in_state, out_state, cm.total_time(),
+                        sim.node_resident_bytes(node, cm)))
+    sh = OpSharding(dp=dp, tp=1, kind="none")
+    cm = sim.op_cost(node, in_shapes, sh)
+    value = (tuple(entries),
+             ("none", "R", "R", cm.total_time(),
+              sim.node_resident_bytes(node, cm)))
+    sim.table_put(key, value)
+    return value
+
+
 def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
               batch_size: int, space: Optional[SearchSpace] = None,
               lam: float = 1.0
@@ -243,7 +294,9 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     ``lam`` mixes runtime and per-chip memory into the DP objective
     (reference: the MemoryOptimConfig run_time_cost_factor,
     memory_optimization.h:24-100): obj = lam * time_ms + (1-lam) * mem_GiB.
-    lam=1.0 is the pure-runtime search.
+    lam=1.0 is the pure-runtime search. The per-node (time, mem) inputs to
+    the mix come from ``_node_cost_entries``' memoized tables, so re-running
+    at a different λ is a pure remix: zero new ``op_cost`` calls.
 
     Fan-in nodes sum their producers' table costs (shared ancestors are
     counted once per branch — an over-estimate the final ``simulate`` pass
@@ -251,6 +304,25 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     other consumers pay conversions. Sink nodes are pinned to state R (the
     loss consumes replicated logits, reference: final-op label matching
     model.cc:3090-3124)."""
+    assignment, states, _table = _dp_core(pcg, sim, dp, tp, space, lam)
+    sim_time = simulate_best(sim, pcg, assignment, states)
+    return assignment, states, sim_time
+
+
+def _dp_core(pcg: PCG, sim: Simulator, dp: int, tp: int,
+             space: Optional[SearchSpace] = None, lam: float = 1.0,
+             prior: Optional[Dict[int, Dict]] = None,
+             dirty: Optional[Set[int]] = None
+             ) -> Tuple[Dict[int, OpSharding], Dict[int, str],
+                        Dict[int, Dict]]:
+    """The DP mix + backtrack behind ``dp_assign``. Returns
+    (assignment, states, dp_table) so callers can reuse the table for
+    incremental re-costing: with ``prior`` (the parent graph's dp_table at
+    the same dp/tp/dcn/space/λ) and ``dirty`` (guids whose rows must be
+    recomputed — the rewritten segment plus its resharding frontier), rows
+    of clean nodes are copied verbatim. Exact, not approximate: a clean
+    node's ancestor cone is untouched by construction (dirty is closed
+    under descendants), so its recomputed row would be bit-identical."""
     from ..ffconst import size_of_datatype
 
     nodes = pcg.compute_nodes()
@@ -260,14 +332,19 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
         return lam * time_s * 1e3 + (1.0 - lam) * mem_bytes / 2 ** 30
 
     INF = float("inf")
-    # table[guid][state] = (obj, time, mem, (kind, in_state))
-    table: Dict[int, Dict[str, Tuple[float, float, float, Tuple[str, str]]]] \
-        = {}
+    # table[guid][state] = (obj, time, mem, (kind, in_state), srcs)
+    table: Dict[int, Dict[str, Tuple[float, float, float, Tuple[str, str],
+                                     Dict[int, str]]]] = {}
+    reuse_rows = prior is not None and dirty is not None
     for node in nodes:
+        if reuse_rows and node.guid not in dirty and node.guid in prior:
+            table[node.guid] = prior[node.guid]
+            continue
         in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
-        opts = node_options(node, tp, in_shapes, space)
+        opts, fallback = _node_cost_entries(sim, node, in_shapes, dp, tp,
+                                            space)
         if node.guid in sink_guids:
-            opts = [o for o in opts if o[2] == "R"] or opts
+            opts = tuple(o for o in opts if o[2] == "R") or opts
 
         def prev_cost(state: str
                       ) -> Tuple[float, float, float, Dict[int, str]]:
@@ -323,38 +400,20 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
 
         tab: Dict[str, Tuple[float, float, float, Tuple[str, str],
                              Dict[int, str]]] = {}
-        for kind, in_state, out_state in opts:
-            eff_tp = tp if kind != "none" else 1
-            act_tp = tp if (kind == "none"
-                            and out_state in ("S", "Q", "H")) else 1
-            sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp)
-            cm = sim.op_cost(node, in_shapes, sh)
+        for kind, in_state, out_state, op_time, node_mem in opts:
             base_o, base_t, base_m, srcs = prev_cost(in_state)
             if base_o >= INF:
                 continue
-            # liveness-aware per-node resident memory — the same per-node
-            # formula Simulator.simulate's peak sums; the DP objective is a
-            # LOWER bound on the full peak (the global transient max-term
-            # cannot decompose per node) and the λ loop's accept/reject
-            # uses the full simulate() model, which includes it
-            node_mem = sim.node_resident_bytes(node, cm)
-            t = base_t + cm.total_time()
+            t = base_t + op_time
             mem = base_m + node_mem
-            obj = base_o + mix(cm.total_time(), node_mem)
+            obj = base_o + mix(op_time, node_mem)
             if out_state not in tab or obj < tab[out_state][0]:
                 tab[out_state] = (obj, t, mem, (kind, in_state), srcs)
         if not tab:  # fallback: unsharded
-            sh = OpSharding(dp=dp, tp=1, kind="none")
-            cm = sim.op_cost(node, in_shapes, sh)
+            _kind, _in, _out, op_time, node_mem = fallback
             base_o, base_t, base_m, srcs = prev_cost("R")
-            # liveness-aware per-node resident memory — the same per-node
-            # formula Simulator.simulate's peak sums; the DP objective is a
-            # LOWER bound on the full peak (the global transient max-term
-            # cannot decompose per node) and the λ loop's accept/reject
-            # uses the full simulate() model, which includes it
-            node_mem = sim.node_resident_bytes(node, cm)
-            tab["R"] = (base_o + mix(cm.total_time(), node_mem),
-                        base_t + cm.total_time(), base_m + node_mem,
+            tab["R"] = (base_o + mix(op_time, node_mem),
+                        base_t + op_time, base_m + node_mem,
                         ("none", "R"), srcs)
         table[node.guid] = tab
 
@@ -384,11 +443,9 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 # from the op's declared in_state when a reshard was cheaper)
                 chosen[g] = srcs[g] if srcs.get(g) in ptab else \
                     min(ptab, key=lambda s: ptab[s][0])
-    # total time: recompute via the simulator so resharding edges and shared
-    # subgraphs are counted exactly once (event-driven when the native
-    # task-graph core is available)
-    sim_time = simulate_best(sim, pcg, assignment, states)
-    return assignment, states, sim_time
+    # the caller recomputes total time via the simulator (simulate_best) so
+    # resharding edges and shared subgraphs are counted exactly once
+    return assignment, states, table
 
 
 _warned_once: Set[str] = set()
@@ -946,6 +1003,35 @@ def _segment_map(pcg: PCG, threshold: int) -> Dict[int, int]:
     return seg
 
 
+def _dirty_after_rewrite(g2: PCG, touched: Sequence[int],
+                         parent_sinks: Set[int]) -> Set[int]:
+    """Guids whose DP rows must be recomputed after a rewrite: the touched
+    (newly created) nodes plus every descendant — the rewritten segment and
+    its resharding frontier. Clean nodes keep their ancestor cone untouched
+    (dirty is closed under consumers), so their parent-graph DP rows are
+    exact, not approximate. Sink-status flips seed the set too: a rule that
+    drops an input can orphan a clean producer into a sink, changing its
+    R pinning."""
+    seeds = {t for t in touched if t in g2.nodes}
+    new_sinks = {n.guid for n in g2.sinks()}
+    for guid in new_sinks.symmetric_difference(parent_sinks):
+        if guid in g2.nodes:
+            seeds.add(guid)
+    consumers: Dict[int, List[int]] = {}
+    for n in g2.nodes.values():
+        for pg, _ in n.inputs:
+            consumers.setdefault(pg, []).append(n.guid)
+    dirty: Set[int] = set()
+    stack = list(seeds)
+    while stack:
+        x = stack.pop()
+        if x in dirty:
+            continue
+        dirty.add(x)
+        stack.extend(consumers.get(x, ()))
+    return dirty
+
+
 def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         batch: int, xfers, budget: int, alpha: float,
                         space: Optional[SearchSpace] = None,
@@ -959,22 +1045,33 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
     search over GraphXfer applications, each candidate costed by the DP, with
     alpha pruning and a budget on explored graphs. Above ``split_threshold``
     compute nodes, rewrites are confined to bottleneck-delimited segments —
-    the reference's recursive split at find_split_node. ``search_log``
-    (obs.SearchLog) records every explored rewrite candidate."""
-    assignment, states, t = dp_assign(pcg, sim, dp, tp, batch, space, lam)
+    the reference's recursive split at find_split_node; matches spanning a
+    split point are not explored (the reference optimizes the pieces
+    separately). ``search_log`` (obs.SearchLog) records every explored
+    rewrite candidate.
+
+    Delta re-costing (ISSUE 2): every candidate carries its DP table, and a
+    rewrite re-runs the DP only over ``GraphXfer.apply``'s touched guids
+    plus their descendants (the resharding frontier) — clean rows are
+    copied from the parent. Falls back to a full re-cost when no parent
+    table is available. Under ``FLEXFLOW_TPU_SEARCH_SELFCHECK`` the delta
+    result is shadowed by a full DP and asserted identical."""
+    assignment, states, table = _dp_core(pcg, sim, dp, tp, space, lam)
+    t = simulate_best(sim, pcg, assignment, states)
     best = (pcg, assignment, states, t)
     if not xfers:
         return best
     counter = itertools.count()
-    heap = [(t, next(counter), pcg)]
+    heap = [(t, next(counter), pcg, table)]
     seen: Set[int] = {pcg.hash()}
     explored = 0
     while heap and explored < budget:
-        cost, _, g = heapq.heappop(heap)
+        cost, _, g, gtable = heapq.heappop(heap)
         if cost > best[3] * alpha:
             continue  # prune (reference: substitution.cc:2288)
         seg = (_segment_map(g, split_threshold) if split_threshold
                and len(g.compute_nodes()) > split_threshold else None)
+        parent_sinks = {n.guid for n in g.sinks()}
         for xfer in xfers:
             for match in xfer.find_matches(g):
                 if any(guid in protected_guids for guid in match.values()):
@@ -983,7 +1080,7 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         {seg.get(guid, -1) for guid in match.values()}) > 1:
                     continue  # spans a split point
                 try:
-                    g2 = xfer.apply(g, match)
+                    g2, touched = xfer.apply(g, match, return_touched=True)
                 except (ValueError, KeyError) as e:
                     _warn_once(f"xfer-apply:{xfer.name}",
                                "xfer %s: match not applicable (%s)",
@@ -994,18 +1091,31 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                     continue
                 seen.add(h)
                 explored += 1
-                a2, s2, t2 = dp_assign(g2, sim, dp, tp, batch, space, lam)
+                dirty = _dirty_after_rewrite(g2, touched, parent_sinks)
+                a2, s2, table2 = _dp_core(g2, sim, dp, tp, space, lam,
+                                          prior=gtable, dirty=dirty)
+                t2 = simulate_best(sim, g2, a2, s2)
+                if selfcheck_enabled():
+                    fa, fs, _ft = _dp_core(g2, sim, dp, tp, space, lam)
+                    if (fa, fs) != (a2, s2):
+                        raise AssertionError(
+                            f"delta-cost selfcheck: incremental DP after "
+                            f"xfer {xfer.name} diverged from the full "
+                            f"re-cost (dirty={len(dirty)}/"
+                            f"{len(g2.compute_nodes())} nodes)")
                 _log.info("xfer %s: %.3f ms -> %.3f ms", xfer.name,
                           best[3] * 1e3, t2 * 1e3)
                 if search_log is not None:
                     search_log.log(event="xfer", xfer=xfer.name, dp=dp,
                                    tp=tp, cost_ms=round(t2 * 1e3, 4),
                                    accepted=bool(t2 < best[3]),
-                                   best_ms=round(min(t2, best[3]) * 1e3, 4))
+                                   best_ms=round(min(t2, best[3]) * 1e3, 4),
+                                   recost_nodes=len(dirty),
+                                   total_nodes=len(g2.compute_nodes()))
                 if t2 < best[3]:
                     best = (g2, a2, s2, t2)
                 if t2 < best[3] * alpha:
-                    heapq.heappush(heap, (t2, next(counter), g2))
+                    heapq.heappush(heap, (t2, next(counter), g2, table2))
                 if explored >= budget:
                     break
             if explored >= budget:
@@ -1024,7 +1134,12 @@ def unity_search(pcg: PCG, config, n_dev: int,
 
     Enumerates mesh factorizations x graph rewrites, runs the {R,S,Q} DP for
     each, applies alpha pruning, then the memory-λ binary search
-    (graph.cc:2060-2133) when ``--memory-search`` is on. When ``calibrate``
+    (graph.cc:2060-2133) when ``--memory-search`` is on. The λ search is a
+    *remix* under the delta-cost engine: the λ=1.0 sweep populates the
+    Simulator's memoized per-node (time, mem) tables, and each subsequent λ
+    iteration re-runs only the DP mix ``lam*time + (1-lam)*mem`` over
+    cached entries — zero new ``op_cost`` calls (λ is not part of any cache
+    key, so every lookup hits). When ``calibrate``
     the per-op cost model is first grounded by on-device measurement
     (reference: simulator.cc:489). The best strategy's sharding transitions
     are materialized as parallel-op IR nodes in ``pcg`` (mutated in place).
@@ -1133,9 +1248,19 @@ def unity_search(pcg: PCG, config, n_dev: int,
                  cost_ms=round(chosen.sim_time * 1e3, 4),
                  mem_mib=round(chosen.sim_memory / 2 ** 20, 1),
                  feasible=bool(mem_budget is None
-                               or chosen.sim_memory <= mem_budget))
+                               or chosen.sim_memory <= mem_budget),
+                 # delta-cost engine counters: a λ remix sweep shows hits
+                 # growing while misses stay flat (zero new op_cost work)
+                 cost_cache_hits=sim.cost_cache_hits,
+                 cost_cache_misses=sim.cost_cache_misses)
         return chosen
 
+    t_search0 = time.perf_counter()
+    # snapshot the cache counters: the reported stats must be THIS search's
+    # deltas, not the Simulator's lifetime totals (a shared sim arrives
+    # pre-warmed by calibration or baseline costing — bench.py does both)
+    cache0 = (sim.cost_cache_hits, sim.cost_cache_misses,
+              sim.table_hits, sim.table_misses)
     with _log.scope("unity_search n_dev=%d" % n_dev), \
             tracer.span("search", n_dev=n_dev):
         best = search_all(lam=1.0)
@@ -1206,13 +1331,37 @@ def unity_search(pcg: PCG, config, n_dev: int,
                         sim_memory=m_pipe, mesh_shape=(n_dev, 1),
                         pcg=None, states=None)
 
+    # delta-cost engine telemetry: wall time, throughput and cache counters
+    # land on the SearchResult (bench.py's search_wall_s metric) and in the
+    # final SearchLog record
+    search_wall_s = time.perf_counter() - t_search0
+    candidates = sum(slog.counts.get(k, 0) for k in
+                     ("candidate", "xfer", "pipeline_candidate"))
+    d_hits = sim.cost_cache_hits - cache0[0]
+    d_misses = sim.cost_cache_misses - cache0[1]
+    cache_stats = {
+        "cost_cache_hits": d_hits,
+        "cost_cache_misses": d_misses,
+        "cost_cache_hit_rate": round(d_hits / (d_hits + d_misses), 4)
+        if d_hits + d_misses else 0.0,
+        "table_hits": sim.table_hits - cache0[2],
+        "table_misses": sim.table_misses - cache0[3],
+    }
     if best is not None:
+        best.search_wall_s = search_wall_s
+        best.candidates = candidates
+        best.cache_stats = cache_stats
         slog.log(event="result", cost_ms=round(best.sim_time * 1e3, 4),
                  mem_mib=round(best.sim_memory / 2 ** 20, 1),
                  mesh=list(best.mesh_shape),
                  pipeline=(list(best.strategy.pipeline)
                            if getattr(best.strategy, "pipeline", None)
-                           else None))
+                           else None),
+                 search_wall_s=round(search_wall_s, 4),
+                 candidates=candidates,
+                 candidates_per_s=round(candidates / search_wall_s, 2)
+                 if search_wall_s > 0 else None,
+                 **cache_stats)
     slog.close()
     if best is None:
         from ..parallel.strategy import data_parallel_strategy

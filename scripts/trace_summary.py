@@ -140,6 +140,14 @@ def summarize_jsonl(records, top: int) -> None:
         final = [r for r in records if r.get("event") == "result"]
         if final:
             print(f"result: {json.dumps(final[-1])}")
+            r = final[-1]
+            if r.get("search_wall_s") is not None:
+                # delta-cost engine headline: throughput + cache hit rate
+                print(f"delta-cost engine: {r.get('candidates', '?')} "
+                      f"candidates in {r['search_wall_s']:.3f} s "
+                      f"({r.get('candidates_per_s', '?')}/s), "
+                      f"op-cost cache hit rate "
+                      f"{r.get('cost_cache_hit_rate', '?')}")
         print("\nbest-so-far trajectory (every ~N/10 iterations):")
         stride = max(len(iters) // 10, 1)
         for r in iters[::stride]:

@@ -1,0 +1,270 @@
+"""Delta-cost search engine (ISSUE 2): memoized op-cost tables, λ remix,
+incremental re-costing after rewrites, and the self-check equivalence gate.
+
+The invariant under test everywhere: caching/delta paths are pure
+accelerations — the chosen strategy and its simulated cost are IDENTICAL
+to full re-costing (``Simulator(cost_cache_size=0)``), and stale entries
+can never be served across ``set_axis_topology`` / calibration updates."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+from flexflow_tpu.models.bert import BertConfig, build_bert
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import (SELFCHECK_ENV, OpSharding,
+                                           Simulator)
+from flexflow_tpu.search.substitution import builtin_xfers
+from flexflow_tpu.search.unity import (best_first_optimize, dp_assign,
+                                       unity_search)
+
+
+def _bert_tiny_pcg(batch=8):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    build_bert(ff, BertConfig.tiny(batch_size=batch))
+    return ff.create_pcg(), config
+
+
+def _mlp_pcg(batch=64, width=1024, hidden=4096):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, width))
+    t = ff.dense(x, hidden)
+    t = ff.relu(t)
+    t = ff.dense(t, width)
+    ff.softmax(ff.dense(t, 8))
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff.create_pcg(), config
+
+
+def _linear_node(pcg):
+    node = next(n for n in pcg.compute_nodes()
+                if n.op.op_type.name == "OP_LINEAR")
+    in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+    return node, in_shapes
+
+
+def _shape_signature(pcg, assignment):
+    """Guid-free fingerprint of a costed graph + assignment (the two runs
+    being compared build structurally identical graphs with different
+    guids)."""
+    return sorted(
+        (n.op.op_type.name, tuple(n.out_shapes[0]) if n.out_shapes else (),
+         assignment[n.guid].kind, assignment[n.guid].dp, assignment[n.guid].tp)
+        for n in pcg.compute_nodes())
+
+
+# --------------------------------------------------------------- op-cost LRU
+def test_op_cost_cache_returns_identical_metrics():
+    pcg, _ = _mlp_pcg()
+    node, in_shapes = _linear_node(pcg)
+    m = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(m)
+    sh = OpSharding(dp=4, tp=2, kind="col")
+    c1 = sim.op_cost(node, in_shapes, sh)
+    assert (sim.cost_cache_hits, sim.cost_cache_misses) == (0, 1)
+    c2 = sim.op_cost(node, in_shapes, sh)
+    assert (sim.cost_cache_hits, sim.cost_cache_misses) == (1, 1)
+    assert c1 == c2
+    # and the cached value equals a cache-disabled simulator's
+    sim_nc = Simulator(m, cost_cache_size=0)
+    assert sim_nc.op_cost(node, in_shapes, sh) == c1
+    assert not sim_nc._cost_cache  # disabled: nothing stored
+
+
+def test_identical_layers_share_cache_entries():
+    """Keys are guid-independent (op params + shapes), so BERT's repeated
+    layers hit the same entries — the reference's per-(op, view) cache:
+    doubling the layer count must add ZERO cache misses."""
+    m = TPUMachineModel.from_generation("v5e", 8)
+    misses = []
+    for layers in (2, 4):
+        config = FFConfig()
+        config.batch_size = 8
+        ff = FFModel(config)
+        build_bert(ff, BertConfig(batch_size=8, seq_len=128, hidden=256,
+                                  num_heads=4, num_layers=layers,
+                                  intermediate=512))
+        pcg = ff.create_pcg()
+        sim = Simulator(m)
+        dp_assign(pcg, sim, 2, 4, 8)
+        misses.append(sim.cost_cache_misses)
+        assert sim.cost_cache_hits > 0
+    assert misses[0] == misses[1], misses
+
+
+# ----------------------------------------------------------------- λ remix
+def test_lambda_remix_equals_full_costing():
+    """Each λ re-runs only the DP mix over cached entries AND lands on the
+    exact strategy a from-scratch full costing picks at that λ."""
+    pcg, _ = _bert_tiny_pcg()
+    m = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(m)
+    dp_assign(pcg, sim, 2, 4, 8, lam=1.0)  # populates the tables
+    for lam in (0.6, 0.2):
+        a, s, t = dp_assign(pcg, sim, 2, 4, 8, lam=lam)
+        sim_nc = Simulator(m, cost_cache_size=0)
+        a_f, s_f, t_f = dp_assign(pcg, sim_nc, 2, 4, 8, lam=lam)
+        assert a == a_f and s == s_f
+        assert t == t_f
+
+
+# ----------------------------------------- incremental re-cost of rewrites
+def test_rewrite_delta_recost_equals_full(monkeypatch):
+    """best_first_optimize's incremental DP (parent table + dirty set)
+    chooses the same rewritten graph at the same simulated cost as full
+    re-costing, with the self-check gate active the whole time."""
+    monkeypatch.setenv(SELFCHECK_ENV, "1")
+    m = TPUMachineModel.from_generation("v5e", 8)
+    results = []
+    for cache in (1 << 17, 0):
+        pcg, _ = _mlp_pcg()
+        sim = Simulator(m, cost_cache_size=cache)
+        g, a, s, t = best_first_optimize(
+            pcg, sim, dp=8, tp=1, batch=64, xfers=builtin_xfers(),
+            budget=16, alpha=1.05)
+        assert len(g.compute_nodes()) < len(pcg.compute_nodes())  # fused
+        results.append((t, _shape_signature(g, a)))
+    (t_delta, sig_delta), (t_full, sig_full) = results
+    assert t_delta == t_full
+    assert sig_delta == sig_full
+
+
+def test_selfcheck_catches_stale_cache_entries(monkeypatch):
+    """The FLEXFLOW_TPU_SEARCH_SELFCHECK gate re-derives every hit: a
+    calibration edit smuggled past invalidate_cost_tables() must raise."""
+    pcg, _ = _mlp_pcg()
+    node, in_shapes = _linear_node(pcg)
+    m = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(m)
+    sh = OpSharding(dp=8)
+    sim.op_cost(node, in_shapes, sh)  # populate
+    # bypass the knob properties: mutate the per-key ratios directly
+    sim._key_calibration[sim._op_key(node, in_shapes)] = 7.0
+    monkeypatch.setenv(SELFCHECK_ENV, "1")
+    with pytest.raises(AssertionError, match="selfcheck"):
+        sim.op_cost(node, in_shapes, sh)
+
+
+def test_graphxfer_apply_returns_touched_guids():
+    pcg, _ = _mlp_pcg()
+    xfer = next(x for x in builtin_xfers() if x.name == "linear_relu_fuse")
+    match = xfer.find_matches(pcg)[0]
+    g2, touched = xfer.apply(pcg, match, return_touched=True)
+    assert touched and all(t in g2.nodes for t in touched)
+    # the touched set is exactly the dst pattern's new nodes
+    assert len(touched) == len(xfer.dst)
+    # 2-arg call keeps returning the graph alone (API compat)
+    g3 = xfer.apply(pcg, match)
+    assert not isinstance(g3, tuple)
+
+
+# ------------------------------------------------- whole-search equivalence
+def test_unity_search_cached_equals_uncached_on_model_zoo():
+    """End-to-end equivalence gate on the model-zoo graphs: same chosen
+    mesh, same simulated time and memory, with and without the engine."""
+    m = TPUMachineModel.from_generation("v5e", 8)
+    for build in (_bert_tiny_pcg, _mlp_pcg):
+        pcg, config = build()
+        runs = []
+        for cache in (1 << 17, 0):
+            sim = Simulator(m, cost_cache_size=cache)
+            res = unity_search(pcg.copy(), config, 8, machine=m,
+                               return_result=True, insert_ir_nodes=False,
+                               sim=sim)
+            runs.append(res)
+        a, b = runs
+        assert a.mesh_shape == b.mesh_shape
+        assert a.sim_time == b.sim_time
+        assert a.sim_memory == b.sim_memory
+        assert getattr(a.strategy, "pipeline", None) == \
+            getattr(b.strategy, "pipeline", None)
+
+
+def test_unity_memory_search_equivalence_with_dcn(monkeypatch):
+    """The λ binary search over a 2-host machine (DCN placements in play),
+    under the self-check gate, matches full re-costing exactly."""
+    monkeypatch.setenv(SELFCHECK_ENV, "1")
+    config_budget_mb = 25
+    m = TPUMachineModel.from_generation("v5e", 8, num_hosts=2)
+    runs = []
+    for cache in (1 << 17, 0):
+        config = FFConfig()
+        config.batch_size = 2048
+        ff = FFModel(config)
+        x = ff.create_tensor((2048, 1024))
+        t = x
+        for _ in range(3):
+            t = ff.dense(t, 1024, ActiMode.AC_MODE_RELU)
+        ff.softmax(ff.dense(t, 8))
+        ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        pcg = ff.create_pcg()
+        config.device_memory_mb = config_budget_mb
+        config.perform_memory_search = True
+        sim = Simulator(m, cost_cache_size=cache)
+        res = unity_search(pcg.copy(), config, 8, machine=m,
+                           return_result=True, insert_ir_nodes=False,
+                           sim=sim)
+        runs.append(res)
+    a, b = runs
+    assert a.mesh_shape == b.mesh_shape and a.dcn == b.dcn
+    assert a.sim_time == b.sim_time and a.sim_memory == b.sim_memory
+    assert a.sim_memory <= config_budget_mb * 2 ** 20
+
+
+# ---------------------------------------------------------- invalidation
+def test_calibration_update_flushes_cost_tables():
+    pcg, _ = _mlp_pcg()
+    node, in_shapes = _linear_node(pcg)
+    m = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(m)
+    sh = OpSharding(dp=8)
+    c1 = sim.op_cost(node, in_shapes, sh)
+    sim.calibrate(measured_step=2.0, simulated_step=1.0)  # calibration x2
+    assert not sim._cost_cache and not sim._table_cache  # flushed
+    c2 = sim.op_cost(node, in_shapes, sh)
+    assert c2.forward_time > c1.forward_time
+    # the recalibrated cached value equals a fresh simulator's
+    fresh = Simulator(m, cost_cache_size=0)
+    fresh.calibration = 2.0
+    assert fresh.op_cost(node, in_shapes, sh) == c2
+
+
+def test_memory_knob_update_flushes_dp_tables():
+    """activation_el (set by calibrate_from_pcg / bench) reshapes the
+    resident-memory term of the cached DP tables — setting it must flush
+    them, and the refreshed λ<1 result must equal a fresh simulator's."""
+    pcg, _ = _bert_tiny_pcg()
+    m = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(m)
+    dp_assign(pcg, sim, 2, 4, 8, lam=0.5)
+    assert sim._table_cache
+    sim.activation_el = 2  # bf16 activations
+    assert not sim._table_cache and not sim._cost_cache
+    a, s, t = dp_assign(pcg, sim, 2, 4, 8, lam=0.5)
+    fresh = Simulator(m, cost_cache_size=0)
+    fresh.activation_el = 2
+    a_f, s_f, t_f = dp_assign(pcg, fresh, 2, 4, 8, lam=0.5)
+    assert a == a_f and s == s_f and t == t_f
+
+
+def test_set_axis_topology_never_serves_stale_entries():
+    """The DCN topology is part of every cache key: costs priced at one
+    placement are never replayed at another, and flipping back re-serves
+    the original entry unchanged."""
+    pcg, _ = _mlp_pcg()
+    node, in_shapes = _linear_node(pcg)
+    m = TPUMachineModel.from_generation("v5e", 8, num_hosts=2)
+    sim = Simulator(m)
+    sh = OpSharding(dp=4, tp=2, kind="row")  # row-parallel: comm depends
+    c_flat = sim.op_cost(node, in_shapes, sh)  # on the tp axis's DCN factor
+    sim.set_axis_topology(dp_dcn=1, tp_dcn=2)
+    c_dcn = sim.op_cost(node, in_shapes, sh)
+    assert c_dcn.comm_time > c_flat.comm_time  # DCN phase priced, not stale
+    fresh = Simulator(m, cost_cache_size=0)
+    fresh.set_axis_topology(dp_dcn=1, tp_dcn=2)
+    assert fresh.op_cost(node, in_shapes, sh) == c_dcn
+    sim.set_axis_topology(1, 1)
+    assert sim.op_cost(node, in_shapes, sh) == c_flat

@@ -1,0 +1,93 @@
+"""Search-perf smoke tests for the delta-cost engine (ISSUE 2 CI leg).
+
+Counter-based, NO wall-clock assertions (a loaded CI host would make any
+timing flaky): the cache hit-rate must be positive on a real search, and a
+λ sweep must make zero ``op_cost`` calls after its first iteration — the
+misses counter is the ground truth for "no new costing work"."""
+import json
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
+from flexflow_tpu.models.bert import BertConfig, build_bert
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.unity import dp_assign, unity_search
+
+
+def _bert_tiny_pcg(batch=8):
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    build_bert(ff, BertConfig.tiny(batch_size=batch))
+    return ff.create_pcg(), config
+
+
+def test_unity_search_cache_hit_rate_positive(tmp_path):
+    """A BERT search must reuse cost entries heavily (repeated layers x
+    factorization sweep), and the stats must land on the SearchResult and
+    in the final SearchLog record."""
+    pcg, config = _bert_tiny_pcg()
+    log = tmp_path / "search.jsonl"
+    config.search_log_file = str(log)
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    res = unity_search(pcg.copy(), config, 8, machine=machine,
+                       return_result=True, insert_ir_nodes=False, sim=sim)
+    assert sim.cost_cache_hits > 0
+    assert res.cache_stats["cost_cache_hit_rate"] > 0
+    assert res.search_wall_s is not None and res.search_wall_s > 0
+    assert res.candidates >= 1
+    records = [json.loads(line) for line in log.read_text().splitlines()]
+    result = [r for r in records if r.get("event") == "result"][-1]
+    for field in ("search_wall_s", "candidates", "candidates_per_s",
+                  "cost_cache_hits", "cost_cache_misses",
+                  "cost_cache_hit_rate"):
+        assert field in result, field
+    assert result["candidates"] == res.candidates
+
+
+def test_lambda_sweep_makes_no_op_cost_calls_after_first_iteration():
+    """The λ remix contract at the DP level: the first sweep populates the
+    tables; every later λ re-runs only the mix — misses frozen, hits
+    growing."""
+    pcg, _ = _bert_tiny_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    dp_assign(pcg, sim, 2, 4, 8, lam=1.0)
+    misses0 = sim.cost_cache_misses
+    hits0 = sim.cost_cache_hits
+    for lam in (0.75, 0.5, 0.25, 0.0):
+        dp_assign(pcg, sim, 2, 4, 8, lam=lam)
+    assert sim.cost_cache_misses == misses0, \
+        "λ remix made new op_cost calls"
+    assert sim.cost_cache_hits > hits0
+
+
+def test_unity_memory_search_lambda_sweeps_are_pure_remix(tmp_path):
+    """End-to-end: a memory-pressured search runs the λ binary search;
+    every sweep_result record after the first must report UNCHANGED
+    cost_cache_misses — the λ loop re-mixes cached tables instead of
+    re-costing the graph (ISSUE 2 tentpole)."""
+    config = FFConfig()
+    config.batch_size = 2048
+    ff = FFModel(config)
+    x = ff.create_tensor((2048, 1024))
+    t = x
+    for _ in range(3):
+        t = ff.dense(t, 1024, ActiMode.AC_MODE_RELU)
+    ff.softmax(ff.dense(t, 8))
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    pcg = ff.create_pcg()
+    log = tmp_path / "memsearch.jsonl"
+    config.search_log_file = str(log)
+    config.device_memory_mb = 25
+    config.perform_memory_search = True
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    unity_search(pcg.copy(), config, 8, machine=machine,
+                 return_result=True, insert_ir_nodes=False)
+    records = [json.loads(line) for line in log.read_text().splitlines()]
+    sweeps = [r for r in records if r.get("event") == "sweep_result"]
+    assert len(sweeps) >= 2, "memory pressure vanished: no λ sweeps ran"
+    misses = [r["cost_cache_misses"] for r in sweeps]
+    assert all(mi == misses[0] for mi in misses[1:]), misses
+    hits = [r["cost_cache_hits"] for r in sweeps]
+    assert hits[-1] > hits[0]

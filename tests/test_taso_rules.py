@@ -29,9 +29,12 @@ def test_rule_file_parses_at_least_90_percent():
     slots (the same id in several ops is the same external tensor, e.g. a
     shared weight)."""
     xfers = load_substitution_json(RULES)
+    # r6: a dst op carrying a semantics-bearing PM_* key WITHOUT a same-type
+    # src template now rejects its rule (it would be built with default
+    # attrs — ADVICE r5), so the count may dip below the full 640; the >=90%
+    # Done criterion still holds because TASO's algebraic rules rewrite the
+    # same op kinds (the dst side inherits real attrs from the match)
     assert len(xfers) >= 0.9 * 640, len(xfers)
-    # with the name mappings the whole collection converts
-    assert len(xfers) == 640, len(xfers)
 
 
 def _branchy_conv_pcg():
