@@ -83,6 +83,14 @@ class FFConfig:
     # fusion & memory search
     perform_fusion: bool = False
     perform_memory_search: bool = False
+    # activation rematerialization (--remat): "" lets the Unity memory
+    # search choose the level; "none"/"selective"/"full" force one —
+    # Executor remat blocks and PipelineTrainer stages alike
+    # (execution/remat.py, docs/remat.md)
+    remat: str = ""
+    # target compute nodes per remat block (blocks cut at graph
+    # bottlenecks; ~one transformer layer at the default)
+    remat_segment_size: int = 8
 
     # machine model for the simulator
     machine_model_version: int = 0
@@ -196,6 +204,14 @@ class FFConfig:
                 self.perform_fusion = True
             elif a == "--memory-search":
                 self.perform_memory_search = True
+            elif a == "--remat":
+                v = _next()
+                if v not in ("none", "selective", "full"):
+                    raise ValueError(
+                        f"--remat expects none|selective|full, got {v!r}")
+                self.remat = v
+            elif a == "--remat-segment-size":
+                self.remat_segment_size = int(_next())
             elif a == "--overlap":
                 self.search_overlap_backward_update = True
             elif a == "--import" or a == "--import-strategy":

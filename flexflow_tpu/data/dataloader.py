@@ -86,30 +86,40 @@ def prefetch_iterator(it: Iterator, shardings: List[Any], depth: int = 2):
     """Background-thread prefetch of device batches (double buffering).
 
     Abandoning the generator early (e.g. fit breaking out on a dynamic
-    recompile) stops the producer promptly — without the stop flag it would
-    stay blocked on ``q.put`` for the rest of the process, pinning its
-    in-flight device batches."""
+    recompile) stops the producer promptly and JOINS it — without the stop
+    flag it would stay blocked on ``q.put`` for the rest of the process,
+    pinning its in-flight device batches; and without the join, a producer
+    mid-``device_put`` could still race one more item into a queue nobody
+    will drain. Producer errors (a raising source iterator, a failed device
+    transfer) propagate to the consumer via the same stop-aware queue path
+    instead of dying silently in the thread — every ``put``, the terminal
+    sentinel and the error included, gives up once the consumer is gone."""
     from queue import Empty, Full
 
     q: Queue = Queue(maxsize=depth)
     stop = threading.Event()
     _END = object()
 
+    def put_or_stop(item) -> bool:
+        """Blocking put that abandons ship when the consumer left; True if
+        the item landed."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except Full:
+                continue
+        return False
+
     def producer():
         try:
             for batch in it:
                 staged = device_put_batch(batch, shardings)
-                while not stop.is_set():
-                    try:
-                        q.put(staged, timeout=0.1)
-                        break
-                    except Full:
-                        continue
-                if stop.is_set():
+                if not put_or_stop(staged):
                     return
-            q.put(_END)
+            put_or_stop(_END)
         except BaseException as e:  # propagate to the consumer, don't swallow
-            q.put(e)
+            put_or_stop(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
@@ -123,7 +133,25 @@ def prefetch_iterator(it: Iterator, shardings: List[Any], depth: int = 2):
             yield item
     finally:
         stop.set()
-        try:  # unblock a producer waiting on a full queue
+        # drain-and-join loop: draining unblocks a producer mid-put, and
+        # every put path above is stop-aware, so the thread exits promptly
+        # — unless it is blocked inside the SOURCE iterator or a device
+        # transfer, which cannot observe the stop flag; bound the wait
+        # (short: this sits on fit's recompile path) and fall back to
+        # leaking the daemon thread (the pre-fix behavior) rather than
+        # stalling the training process in generator close
+        import time as _time
+
+        deadline = _time.monotonic() + 1.0
+        while t.is_alive() and _time.monotonic() < deadline:
+            try:
+                while True:
+                    q.get_nowait()
+            except Empty:
+                pass
+            t.join(timeout=0.1)
+        # final drain drops any last raced-in item's device buffers
+        try:
             while True:
                 q.get_nowait()
         except Empty:

@@ -43,6 +43,9 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._forward_jit = None
+        # the RematPlan make_train_step resolved and applied (None until
+        # built, and None when remat is off/ineligible) — telemetry reads it
+        self.remat_plan = None
         # cache-op state (reference: src/ops/cache.cc — cached intermediate
         # tensors across iterations, host-scored, paired with recompile)
         self.cache_nodes = [n for n in pcg.compute_nodes()
@@ -171,44 +174,48 @@ class Executor:
         return logits
 
     # ------------------------------------------------------------------ forward
-    def forward_outputs(self, params, bound_inputs: Dict[int, Any],
-                        ctx: OpContext) -> Dict[int, List[Any]]:
-        """Run the graph; returns {node_guid: [outputs]}."""
+    def _exec_node(self, node: PCGNode, node_params, inputs,
+                   ctx: OpContext) -> List[Any]:
+        """Run ONE node: per-node OpContext (guid-folded rng), per-op
+        named scope (op names become HLO metadata, so XLA/xprof timelines
+        attribute fused kernels back to PCG nodes — the reference gets
+        this from per-op Legion task names; here it is free at trace
+        time), and the strategy's output sharding constraint. The single
+        recipe both the plain forward and the remat blocks execute."""
         import jax
         import jax.lax as lax
 
+        node_ctx = OpContext(
+            training=ctx.training,
+            rng=(jax.random.fold_in(ctx.rng, node.guid)
+                 if ctx.rng is not None else None),
+            seq_length=ctx.seq_length, mesh=ctx.mesh,
+            profiling=ctx.profiling, aux_losses=ctx.aux_losses,
+            cache_in=ctx.cache_in, cache_out=ctx.cache_out)
+        with jax.named_scope(node.name):
+            outs = node.op.forward(node_params, inputs, node_ctx)
+        # apply the strategy's output sharding constraint (parallel ops and
+        # any node the search pinned)
+        ns = self.strategy.node_strategies.get(node.guid)
+        if ns is not None and ns.output_spec is not None \
+                and self.mesh is not None:
+            sh = self._named_sharding(ns.output_spec)
+            outs = [lax.with_sharding_constraint(outs[0], sh)] + outs[1:]
+        return outs
+
+    def forward_outputs(self, params, bound_inputs: Dict[int, Any],
+                        ctx: OpContext) -> Dict[int, List[Any]]:
+        """Run the graph; returns {node_guid: [outputs]}."""
         values: Dict[int, List[Any]] = {}
         for node in self.pcg.topo_order():
             op = node.op
-            if op.op_type == OperatorType.OP_INPUT:
-                values[node.guid] = [bound_inputs[node.guid]]
-                continue
-            if op.op_type == OperatorType.OP_WEIGHT:
+            if op.op_type in (OperatorType.OP_INPUT,
+                              OperatorType.OP_WEIGHT):
                 values[node.guid] = [bound_inputs[node.guid]]
                 continue
             inputs = [values[g][i] for g, i in node.inputs]
-            node_params = params.get(node.name, {})
-            node_ctx = OpContext(
-                training=ctx.training,
-                rng=(jax.random.fold_in(ctx.rng, node.guid)
-                     if ctx.rng is not None else None),
-                seq_length=ctx.seq_length, mesh=ctx.mesh,
-                profiling=ctx.profiling, aux_losses=ctx.aux_losses,
-                cache_in=ctx.cache_in, cache_out=ctx.cache_out)
-            # per-op named scope: op names become HLO metadata, so XLA/xprof
-            # timelines attribute fused kernels back to PCG nodes (the
-            # reference gets this from per-op Legion task names in Legion
-            # Prof; here it is free at trace time, zero cost at run time)
-            with jax.named_scope(node.name):
-                outs = op.forward(node_params, inputs, node_ctx)
-            # apply the strategy's output sharding constraint (parallel ops and
-            # any node the search pinned)
-            ns = self.strategy.node_strategies.get(node.guid)
-            if ns is not None and ns.output_spec is not None \
-                    and self.mesh is not None:
-                sh = self._named_sharding(ns.output_spec)
-                outs = [lax.with_sharding_constraint(outs[0], sh)] + outs[1:]
-            values[node.guid] = outs
+            values[node.guid] = self._exec_node(
+                node, params.get(node.name, {}), inputs, ctx)
         return values
 
     def _bind_inputs(self, xs: List[Any]) -> Dict[int, Any]:
@@ -216,6 +223,103 @@ class Executor:
         assert len(xs) == len(input_nodes), \
             f"model has {len(input_nodes)} inputs, got {len(xs)}"
         return {n.guid: x for n, x in zip(input_nodes, xs)}
+
+    # ------------------------------------------------- rematerialized forward
+    def _build_remat_program(self, plan):
+        """Compile the PCG into checkpointed remat blocks for ``plan``
+        (execution/remat.py — the SAME segmentation the Simulator's memory
+        model prices). Each block becomes a pure function
+        ``(block_params, boundary_values, rng) -> (exposed_outputs, aux)``
+        wrapped in ``jax.checkpoint`` with the plan's save policy, so the
+        backward pass recomputes the block's interior instead of saving it.
+        Per-op ``jax.named_scope`` is preserved inside the blocks (the
+        recompute shows up attributed in xprof timelines)."""
+        import jax
+
+        from ..ops.base import OpContext
+        from .remat import checkpoint_policy, remat_segments
+
+        policy = checkpoint_policy(plan.level)
+        segments = remat_segments(self.pcg, plan.segment_size)
+        seg_of = {g: k for k, seg in enumerate(segments) for g in seg}
+        # every (guid, out_idx) consumed across a block boundary (or the
+        # loss anchor) must be exposed as a block output — these are the
+        # only activations `full` remat keeps
+        needed = {(self.final_guid, self.final_out_idx)}
+        for node in self.pcg.compute_nodes():
+            for pg, i in node.inputs:
+                if pg in seg_of and seg_of[pg] != seg_of[node.guid]:
+                    needed.add((pg, i))
+
+        mesh = self.mesh
+        profiling = bool(getattr(self.config, "profiling", False))
+        program = []
+        for k, seg in enumerate(segments):
+            seg_set = set(seg)
+            ext_refs: List[Tuple[int, int]] = []
+            seen = set()
+            for g in seg:
+                for pg, i in self.pcg.nodes[g].inputs:
+                    if pg in seg_set or (pg, i) in seen:
+                        continue
+                    seen.add((pg, i))
+                    ext_refs.append((pg, i))
+            out_refs = [(g, i) for g in seg
+                        for i in range(len(self.pcg.nodes[g].out_shapes))
+                        if (g, i) in needed]
+            names = [self.pcg.nodes[g].name for g in seg]
+
+            def make_fn(seg=seg, ext_refs=ext_refs, out_refs=out_refs):
+                def fn(block_params, ext_vals, rng):
+                    import jax.numpy as jnp
+
+                    values = dict(zip(ext_refs, ext_vals))
+                    aux: List[Any] = []
+                    # block-local ctx: _exec_node folds the rng per node,
+                    # exactly as the plain forward does (recompute replays
+                    # identical dropout masks); cache fields stay None —
+                    # CacheOp graphs never reach the remat path
+                    block_ctx = OpContext(training=True, rng=rng,
+                                          mesh=mesh, profiling=profiling,
+                                          aux_losses=aux)
+                    for g in seg:
+                        node = self.pcg.nodes[g]
+                        inputs = [values[(pg, i)] for pg, i in node.inputs]
+                        outs = self._exec_node(
+                            node, block_params.get(node.name, {}), inputs,
+                            block_ctx)
+                        for i, v in enumerate(outs):
+                            values[(g, i)] = v
+                    # aux losses leave the block as an explicit output —
+                    # appending traced interiors to a host-side list from
+                    # inside jax.checkpoint would leak residual tracers
+                    aux_sum = sum(aux) if aux else jnp.zeros((), jnp.float32)
+                    return tuple(values[r] for r in out_refs), aux_sum
+                return fn
+
+            fn = make_fn()
+            if policy is not None:
+                fn = jax.checkpoint(fn, policy=policy)
+            program.append((fn, ext_refs, out_refs, names, k))
+        return program
+
+    def _forward_remat(self, params, bound_inputs: Dict[int, Any],
+                       ctx: OpContext, program):
+        """Run the checkpointed block program; returns the loss-anchor
+        logits. Boundary values flow block to block; everything interior is
+        recomputed in backward per the plan's policy."""
+        import jax
+
+        values = {(g, 0): v for g, v in bound_inputs.items()}
+        for fn, ext_refs, out_refs, names, k in program:
+            block_params = {n: params[n] for n in names if n in params}
+            ext_vals = tuple(values[r] for r in ext_refs)
+            with jax.named_scope(f"remat_block_{k}"):
+                outs, aux = fn(block_params, ext_vals, ctx.rng)
+            if ctx.aux_losses is not None:
+                ctx.aux_losses.append(aux)
+            values.update(zip(out_refs, outs))
+        return values[(self.final_guid, self.final_out_idx)]
 
     # ----------------------------------------------------------- cache state
     def init_cache(self):
@@ -239,7 +343,14 @@ class Executor:
 
         With CacheOps in the graph the step takes the cache pytree as an
         extra trailing argument and returns the fresh cache values as an
-        extra trailing result (reference: cache.cc's update/score tasks)."""
+        extra trailing result (reference: cache.cc's update/score tasks).
+
+        Activation rematerialization (ISSUE 3): the resolved RematPlan
+        (``--remat`` flag > searched ``strategy.remat`` > none) routes the
+        forward through checkpointed remat blocks — ``jax.checkpoint``
+        with the leveled save policy over bottleneck-cut segments — so the
+        saved-for-backward set shrinks to what the plan keeps. Donation
+        and the per-op named_scope observability are unchanged."""
         import jax
 
         if self._train_step is not None:
@@ -251,14 +362,35 @@ class Executor:
 
         profiling = bool(getattr(self.config, "profiling", False))
 
+        from .remat import resolve_remat_plan
+
+        plan = resolve_remat_plan(self.config, self.strategy)
+        remat_program = None
+        if plan.level != "none":
+            if has_cache:
+                import warnings
+
+                warnings.warn(
+                    "remat disabled for this model: CacheOps fill a "
+                    "host-side dict jax.checkpoint cannot trace through")
+            else:
+                remat_program = self._build_remat_program(plan)
+        self.remat_plan = plan if remat_program is not None else None
+
         def loss_fn(params, xs, labels, rng, cache):
             params_c, xs = self._cast_for_compute(params, xs)
             cache_out = {}
             ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[],
                             profiling=profiling,
                             cache_in=cache, cache_out=cache_out)
-            values = self.forward_outputs(params_c, self._bind_inputs(xs), ctx)
-            logits = self._logits_f32(values[self.final_guid][self.final_out_idx])
+            if remat_program is not None:
+                raw = self._forward_remat(params_c, self._bind_inputs(xs),
+                                          ctx, remat_program)
+            else:
+                values = self.forward_outputs(params_c,
+                                              self._bind_inputs(xs), ctx)
+                raw = values[self.final_guid][self.final_out_idx]
+            logits = self._logits_f32(raw)
             loss = loss_value(self.loss_type, logits, labels,
                               self.repl_labels)
             for aux in ctx.aux_losses:

@@ -1,0 +1,145 @@
+"""Activation rematerialization: leveled ``jax.checkpoint`` policies as a
+first-class, *searched* training knob.
+
+The reference trades activation memory for recompute only implicitly (Legion
+instance eviction); modern practice makes it a planned decision — Checkmate
+(Jain et al., MLSys'20) optimizes what to recompute jointly with the
+schedule, and selective recomputation (Korthikanti et al., 2022) recovers
+most transformer activation memory for a few percent extra flops. JAX ships
+the mechanism (``jax.checkpoint`` with save policies); this module makes it
+a plan the Unity memory search can choose per strategy instead of the
+all-or-nothing full remat previously hard-coded in ``PipelineTrainer``:
+
+* ``none``       — save every residual (the default training regime).
+* ``selective``  — save matmul/contraction outputs, recompute the cheap
+  elementwise/norm/softmax tail (``jax.checkpoint_policies.dots_saveable``).
+* ``full``       — save only remat-block boundaries, recompute everything
+  (``nothing_saveable``) — the classic GPipe/full-remat trade.
+
+One accounting contract, three consumers: ``remat_segments`` below is the
+single segmentation used by the Executor's checkpointed forward, by
+``Simulator.simulate``'s analytic peak (boundary + recompute transient), and
+— via ``Simulator.remat_keep_fraction`` — by ``unity``'s DP tables and
+pipeline stage-memory estimate, so the search prices exactly what the
+executor runs. See ``docs/remat.md``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..ffconst import OperatorType
+
+# the searched axis, in preference order for cost ties (none is fastest)
+REMAT_LEVELS = ("none", "selective", "full")
+
+# ops whose outputs the `selective` policy keeps resident (MXU-bound
+# contractions — recomputing them would double the expensive flops; the
+# elementwise/norm/softmax/gather tail between them is the cheap recompute).
+# THE single source: simulator._MATMUL_OPS aliases this set, so the MXU
+# roofline classification and the analytic keep-fraction always match what
+# the dots_saveable policy actually saves (dot_general outputs; an
+# embedding gather is NOT a dot and is recomputed).
+REMAT_SAVEABLE_OPS = {
+    OperatorType.OP_LINEAR, OperatorType.OP_CONV2D,
+    OperatorType.OP_BATCHMATMUL, OperatorType.OP_MULTIHEAD_ATTENTION,
+    OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
+    OperatorType.OP_AGG_SPEC, OperatorType.OP_EXPERTS,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RematPlan:
+    """A rematerialization plan for one training step.
+
+    ``level`` is one of REMAT_LEVELS; ``segment_size`` is the target number
+    of compute nodes per remat block (blocks cut at graph bottlenecks, so a
+    transformer layer's ~8-node body lands in one block by default)."""
+
+    level: str = "none"
+    segment_size: int = 8
+
+    def __post_init__(self):
+        if self.level not in REMAT_LEVELS:
+            raise ValueError(
+                f"remat level {self.level!r} not in {REMAT_LEVELS}")
+
+
+def checkpoint_policy(level: str):
+    """The jax.checkpoint save policy for a remat level (None = do not wrap:
+    the ``none`` level must stay zero-overhead, not an everything_saveable
+    wrapper XLA still has to look through)."""
+    if level == "none":
+        return None
+    import jax
+
+    if level == "selective":
+        return jax.checkpoint_policies.dots_saveable
+    if level == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    raise ValueError(f"unknown remat level {level!r}")
+
+
+def wrap_remat(fn, level: str):
+    """Wrap a pure forward function in jax.checkpoint at ``level``
+    (identity for ``none``). Used by PipelineTrainer's stage functions —
+    the leveled replacement for its previous hand-rolled full-remat VJP."""
+    policy = checkpoint_policy(level)
+    if policy is None:
+        return fn
+    import jax
+
+    return jax.checkpoint(fn, policy=policy)
+
+
+def remat_segments(pcg, segment_size: int = 8) -> List[List[int]]:
+    """Contiguous remat blocks over the PCG's compute nodes, cut at graph
+    bottlenecks (a bottleneck's output is the only live tensor at the cut,
+    so block boundaries are the cheapest tensors to save). Falls back to a
+    forced cut at 4x segment_size when a graph has no bottlenecks (e.g.
+    dense residual meshes), bounding the recompute transient.
+
+    This is THE segmentation: the Executor checkpoints exactly these blocks
+    and the Simulator's full-remat memory model prices exactly these
+    boundaries, so analytic deltas track XLA's."""
+    nodes = pcg.compute_nodes()
+    if not nodes:
+        return []
+    bns = set(pcg.bottlenecks())
+    segs: List[List[int]] = [[]]
+    count = 0
+    for n in nodes:
+        segs[-1].append(n.guid)
+        count += 1
+        if count >= max(segment_size, 1) and n.guid in bns \
+                or count >= 4 * max(segment_size, 1):
+            segs.append([])
+            count = 0
+    if not segs[-1]:
+        segs.pop()
+    return segs
+
+
+def resolve_remat_plan(config, strategy) -> RematPlan:
+    """The executor's plan: the ``--remat`` flag wins, then the searched
+    strategy's level, then none. Strategy.remat == "" means UNSET (an
+    imported/unsearched strategy), distinct from a searched "none".
+    ``remat_segment_size`` (config attr) sizes the blocks."""
+    level = (getattr(config, "remat", "") or "").strip() \
+        or getattr(strategy, "remat", "") or "none"
+    return RematPlan(level=level,
+                     segment_size=int(getattr(config, "remat_segment_size",
+                                              8) or 8))
+
+
+def resolve_stage_remat(config, strategy) -> str:
+    """The pipeline trainer's stage-level remat: flag > searched level >
+    ``full`` (the pre-leveled PipelineTrainer behavior — stages always
+    rematerialized their forward, and an UNSEARCHED pipeline strategy
+    (remat == "") keeps that; only an explicit searched/forced "none"
+    turns stage remat off)."""
+    level = (getattr(config, "remat", "") or "").strip() \
+        or getattr(strategy, "remat", "") or "full"
+    if level not in REMAT_LEVELS:
+        raise ValueError(f"remat level {level!r} not in {REMAT_LEVELS}")
+    return level

@@ -676,13 +676,16 @@ class FFModel:
         # them (reference: OP_PIPELINE is enum-only — this is beyond parity)
         self._pipeline_trainer = None
         if getattr(self.strategy, "pipeline", None):
+            from .execution.remat import resolve_stage_remat
             from .parallel.pipeline import PipelineTrainer
 
             pp, pdp, n_micro = self.strategy.pipeline
             self._pipeline_trainer = PipelineTrainer(
                 self, pp=pp, dp=pdp, n_micro=n_micro,
                 optimizer=self.optimizer, loss_type=loss_type,
-                init_params=False)  # fit() seeds from the live params
+                init_params=False,  # fit() seeds from the live params
+                # stage remat: --remat flag > searched level > GPipe full
+                remat=resolve_stage_remat(self.config, self.strategy))
 
     def create_pcg(self):
         """Layer graph -> PCG (reference: create_operators_from_layers,

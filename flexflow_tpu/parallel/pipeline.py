@@ -10,9 +10,10 @@ reference parity with a working TPU-native design:
   immediate-post-dominator machinery the reference's sequence splits use).
 * ``PipelineTrainer``: GPipe schedule — the global batch is split into
   microbatches; each stage lives on its own submesh of a (pipe, data) device
-  grid, with data parallelism inside the stage. Backward is rematerialized
-  (recompute-the-stage-forward inside the stage's VJP — the standard
-  GPipe + full-remat recipe, same memory/compute trade as ``jax.checkpoint``).
+  grid, with data parallelism inside the stage. Stage backward runs through
+  a leveled ``jax.checkpoint`` policy (``remat=`` none|selective|full,
+  execution/remat.py — the same machinery as the Executor's remat blocks);
+  ``full`` is the classic GPipe recompute-the-stage recipe and the default.
   Stage-boundary activations move between submeshes via ``jax.device_put``
   (ICI transfers on real hardware); JAX's async dispatch overlaps microbatch
   k's stage-s compute with microbatch k+1's stage-(s-1) compute — the GPipe
@@ -200,12 +201,21 @@ class PipelineTrainer:
                  loss_type: LossType =
                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                  devices: Optional[Sequence] = None,
-                 init_params: bool = True):
+                 init_params: bool = True, remat: str = "full"):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         from ..execution.optimizers import SGDOptimizer
+        from ..execution.remat import REMAT_LEVELS
 
+        if remat not in REMAT_LEVELS:
+            raise ValueError(f"remat {remat!r} not in {REMAT_LEVELS}")
+        # stage-remat level: the SAME jax.checkpoint policy machinery the
+        # Executor's remat blocks use (execution/remat.py) — `full` is the
+        # classic GPipe recipe this trainer previously hard-coded as a
+        # hand-rolled VJP; `selective` keeps contraction outputs across the
+        # stage backward; `none` saves every stage residual in-jit
+        self.remat = remat
         self.loss_type = loss_type
         self.pp, self.dp = pp, dp
         self.n_micro = n_micro or pp
@@ -282,7 +292,13 @@ class PipelineTrainer:
                     return outs, aux
                 return f
 
-            f = make_forward()
+            # leveled stage remat: wrap the stage forward in jax.checkpoint
+            # with the trainer's policy, so every differentiation below
+            # (mid-stage VJP and last-stage value_and_grad alike) saves
+            # only what the level keeps and recomputes the rest
+            from ..execution.remat import wrap_remat
+
+            f = wrap_remat(make_forward(), self.remat)
             is_last = (s == len(self.specs) - 1)
             if is_last:
                 final_pos = out_refs.index(self.final_ref)
@@ -308,7 +324,9 @@ class PipelineTrainer:
                     return outs
 
                 def mid_bwd(params, ins, rng, cots, _f=f):
-                    # rematerialized VJP: recompute the stage forward
+                    # VJP through the policy-wrapped stage forward: what is
+                    # saved vs recomputed between the in-jit forward and
+                    # backward is the checkpoint policy's call, not ours
                     import jax.numpy as jnp
 
                     def run(p, i):

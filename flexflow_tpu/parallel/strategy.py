@@ -47,6 +47,13 @@ class Strategy:
     # GPipe pipeline selected by the search: (pp, dp, n_micro). Training
     # routes through parallel.pipeline.PipelineTrainer; None = pure SPMD.
     pipeline: Optional[Tuple[int, int, int]] = None
+    # activation-rematerialization level the search chose (ISSUE 3):
+    # none | selective | full, or "" = unset (strategy predates the remat
+    # axis / was not searched). The distinction matters: an explicit
+    # "none" is a searched decision, while "" lets the execution defaults
+    # apply — Executor blocks default to none, PipelineTrainer stages to
+    # the classic GPipe full remat. ``--remat`` overrides either way.
+    remat: str = ""
     # multi-host placement: (ici_shape, dcn_shape) with
     # ici[i] * dcn[i] == mesh_shape[i]; the mesh is then built with
     # build_hybrid_mesh so an axis's DCN factor never splits an ICI ring
@@ -63,6 +70,7 @@ class Strategy:
             "axis_names": list(self.axis_names),
             "data_axis": self.data_axis,
             "pipeline": list(self.pipeline) if self.pipeline else None,
+            "remat": self.remat,
             "hybrid": [list(self.hybrid[0]), list(self.hybrid[1])]
             if self.hybrid else None,
             "nodes": {},
@@ -90,6 +98,7 @@ class Strategy:
                      data_axis=d.get("data_axis", "data"),
                      pipeline=tuple(d["pipeline"])
                      if d.get("pipeline") else None,
+                     remat=d.get("remat", "") or "",
                      hybrid=(tuple(d["hybrid"][0]), tuple(d["hybrid"][1]))
                      if d.get("hybrid") else None)
         by_name = {n.name: n.guid for n in pcg.topo_order()}

@@ -23,6 +23,11 @@ tasks). TPU-native differences (SURVEY §7 hard-part 3):
   Calibration and memory-model knob changes flush the tables; the
   ``FLEXFLOW_TPU_SEARCH_SELFCHECK`` env var enables a test-only gate that
   re-derives every hit and asserts equality. See ``docs/search.md``.
+* Remat axis (ISSUE 3): ``OpSharding.remat`` prices activation
+  rematerialization — recompute time in backward, saved bytes scaled by
+  ``remat_keep_fraction`` (shared with unity's DP tables and pipeline
+  stage estimate), and ``simulate``'s full-remat peaks priced on the SAME
+  remat blocks the Executor checkpoints. See ``docs/remat.md``.
 """
 from __future__ import annotations
 
@@ -30,21 +35,20 @@ import dataclasses
 import math
 import os
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..execution.remat import REMAT_SAVEABLE_OPS, remat_segments
 from ..ffconst import OperatorType, size_of_datatype
 from ..parallel.pcg import PCG, PCGNode
 from .machine_model import TPUMachineModel
 
-# ops whose cost is MXU-bound
-_MATMUL_OPS = {
-    OperatorType.OP_LINEAR, OperatorType.OP_CONV2D,
-    OperatorType.OP_BATCHMATMUL, OperatorType.OP_MULTIHEAD_ATTENTION,
-    OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
-    OperatorType.OP_AGG_SPEC, OperatorType.OP_EXPERTS,
-}
+# ops whose cost is MXU-bound — the same contraction family whose outputs
+# the `selective` remat policy saves; ONE set (execution/remat.py) so the
+# roofline classification and the analytic keep-fraction can never drift
+# from the dots_saveable policy's actual save set
+_MATMUL_OPS = REMAT_SAVEABLE_OPS
 
 
 @dataclasses.dataclass
@@ -76,12 +80,18 @@ class OpSharding:
     ``act_tp`` covers pass-through sharded states (kind == "none" but the
     activation rides the model axis in state S or Q): the op's compute and
     activation memory shard over dp*act_tp while its weights stay
-    replicated — e.g. a per-token dense inside a sequence-parallel region."""
+    replicated — e.g. a per-token dense inside a sequence-parallel region.
+
+    ``remat`` is the activation-rematerialization level this op trains
+    under (execution.remat.REMAT_LEVELS): it is part of the op-cost cache
+    key by construction (this dataclass is the key component), so costs
+    priced at one level are never served at another."""
 
     dp: int = 1
     tp: int = 1
     kind: str = "none"  # none|col|row|heads|table|expert|ring
     act_tp: int = 1
+    remat: str = "none"  # none|selective|full (jax.checkpoint level)
 
     @property
     def degree(self) -> int:
@@ -201,6 +211,12 @@ class Simulator:
     opt_state_words = _cost_knob("opt_state_words")
     activation_el = _cost_knob(
         "activation_el", "bytes per saved-activation element (compute dtype)")
+    remat_segment_size = _cost_knob(
+        "remat_segment_size",
+        "compute nodes per full-remat block — MUST match the Executor's "
+        "config.remat_segment_size or the analytic boundary/transient "
+        "pricing diverges from the blocks actually checkpointed "
+        "(unity_search threads it through)")
 
     def __init__(self, machine: TPUMachineModel,
                  overlap_backward_update: bool = False,
@@ -256,6 +272,19 @@ class Simulator:
         # XLA saves residuals in the COMPUTE dtype, so bf16 halves the
         # activation term of the peak-memory model
         self.activation_el: Optional[int] = None
+        # full-remat block size for simulate()'s boundary/transient pricing
+        # (RematPlan.segment_size default; unity_search overrides from
+        # config so sim and executor cut identical blocks)
+        self.remat_segment_size = 8
+        # per-graph segmentation memo (bottleneck analysis is O(V+E) and
+        # simulate() sits in the search's hottest loop); weak keys — a
+        # dead candidate graph drops its entry, and object identity avoids
+        # the guid-mismatch a structural-hash key would allow between
+        # isomorphic graphs with different guids
+        import weakref
+
+        self._segment_memo: "weakref.WeakKeyDictionary[PCG, Dict]" = \
+            weakref.WeakKeyDictionary()
         self._dispatch_overhead: Optional[float] = None
         # which mesh axis carries the machine's DCN factor for the candidate
         # being costed (reference: intra- vs inter-node pricing in
@@ -285,15 +314,50 @@ class Simulator:
         """This node's saved-activation bytes in the compute dtype."""
         return self.scaled_bytes(cm.outputs_memory, node)
 
-    def node_resident_bytes(self, node: PCGNode, cm: "CostMetrics") -> int:
+    @staticmethod
+    def remat_keep_fraction(node: PCGNode, level: str) -> float:
+        """Fraction of this node's saved-for-backward activation that stays
+        resident under a remat level — THE shared accounting all three
+        memory consumers price with (simulate's peak, unity's DP tables,
+        simulate_pipeline's stage estimate; see execution/remat.py):
+        ``none`` keeps everything; ``selective`` keeps only contraction
+        outputs (the dots_saveable policy's save set) and recomputes the
+        cheap tail; ``full`` keeps nothing per node — block boundaries and
+        the recompute transient are priced separately in ``simulate``."""
+        if level == "none" or level not in ("selective", "full"):
+            return 1.0
+        if level == "selective":
+            return 1.0 if node.op.op_type in REMAT_SAVEABLE_OPS else 0.0
+        return 0.0
+
+    def node_resident_bytes(self, node: PCGNode, cm: "CostMetrics",
+                            remat: str = "none") -> int:
         """Per-node resident memory under the liveness-aware model — the
         SAME formula ``simulate``'s peak sums (saved activation in the
-        compute dtype + f32 master weights with optimizer moments + the
-        weight grad in the compute dtype), shared so the memory-λ DP and
-        the feasibility check price one model."""
-        return (self.act_bytes(node, cm)
+        compute dtype scaled by the remat keep-fraction + f32 master
+        weights with optimizer moments + the weight grad in the compute
+        dtype), shared so the memory-λ DP and the feasibility check price
+        one model. Under ``full`` remat the per-node activation term is 0
+        (a LOWER bound — simulate() adds back block boundaries and the
+        recompute transient, which do not decompose per node)."""
+        keep = self.remat_keep_fraction(node, remat)
+        return (int(self.act_bytes(node, cm) * keep)
                 + cm.weights_memory * (1 + self.opt_state_words)
                 + self.scaled_bytes(cm.weights_memory, node))
+
+    def _remat_segments_for(self, pcg: PCG):
+        """Memoized ``remat_segments`` at the simulator's block size —
+        identical cuts to the Executor's; keyed by graph identity so the
+        memo can never serve another graph's guids."""
+        per = self._segment_memo.get(pcg)
+        if per is None:
+            per = {}
+            self._segment_memo[pcg] = per
+        size = self.remat_segment_size
+        segs = per.get(size)
+        if segs is None:
+            per[size] = segs = remat_segments(pcg, size)
+        return segs
 
     def _nic_sharers(self, group_ici: int) -> int:
         """Concurrent distinct collective groups per host sharing the NIC:
@@ -403,6 +467,16 @@ class Simulator:
         # 2x/1x heuristic otherwise
         bwd = fwd * self._key_bwd_ratio.get(
             key, 2.0 if w_bytes else 1.0)
+        # rematerialization recompute rides the backward pass: `full`
+        # re-runs every forward once inside the VJP (the GPipe stage-remat
+        # trade simulate_pipeline previously hand-rolled); `selective`
+        # (dots_saveable) re-runs only the non-contraction tail. Block
+        # boundaries under `full` are saved, not recomputed — one node per
+        # ~segment_size, absorbed into this per-node bound.
+        if sh.remat == "full" or (sh.remat == "selective"
+                                  and self.remat_keep_fraction(
+                                      node, "selective") < 1.0):
+            bwd += fwd
 
         # DCN subfactors of each axis for the candidate being costed (clamped
         # when this op's sharding does not span the full axis)
@@ -559,12 +633,15 @@ class Simulator:
             #  - activations: every saved-for-backward output is live at
             #    once when backward starts, in the COMPUTE dtype (bf16
             #    halves it under mixed precision) — x1, not x2: activation
-            #    grads are freed as backward consumes them
+            #    grads are freed as backward consumes them. Remat scales
+            #    this by the keep-fraction; `full`-level nodes keep nothing
+            #    here (block boundaries + recompute transient added below)
             #  - transient: the widest node's working set (its output grad +
             #    recomputed output + weight grad)
             act = self.act_bytes(node, cm)
             wgrad = self.scaled_bytes(cm.weights_memory, node)
-            resident_act += act
+            resident_act += int(act * self.remat_keep_fraction(node,
+                                                               sh.remat))
             resident_w += cm.weights_memory * (1 + self.opt_state_words) \
                 + wgrad
             transient = max(transient, 2 * act + wgrad)
@@ -581,6 +658,37 @@ class Simulator:
                 # x2: the backward pass runs the transposed resharding
                 total_comm += 2 * self.resharding_cost(
                     nbytes, src_state, my_state, sh.dp, sh.tp)
+        # `full`-remat blocks: jax.checkpoint(nothing_saveable) over the
+        # SAME segments the Executor cuts (execution.remat.remat_segments —
+        # one segmentation, two consumers) saves only each block's exposed
+        # boundary outputs; during a block's backward the whole block's
+        # activations rematerialize transiently. Price exactly that: every
+        # cross-block-consumed tensor (the Executor's `needed` set — a
+        # forced, non-bottleneck cut can expose several per boundary, e.g.
+        # a skip connection) plus the graph sinks stay resident, and the
+        # widest block is the transient floor.
+        full_guids = {g for g, s in assignment.items()
+                      if getattr(s, "remat", "none") == "full"}
+        if full_guids:
+            segs = self._remat_segments_for(pcg)
+            seg_of = {g: k for k, seg in enumerate(segs) for g in seg}
+            boundary: Set[int] = set()
+            for n in pcg.compute_nodes():
+                k = seg_of.get(n.guid)
+                for pg, _i in n.inputs:
+                    pk = seg_of.get(pg)
+                    if pk is not None and pk != k:
+                        boundary.add(pg)
+            boundary.update(n.guid for n in pcg.sinks()
+                            if n.guid in seg_of)
+            for seg in segs:
+                seg_live = sum(self.act_bytes(pcg.nodes[g], el_cache[g])
+                               for g in seg
+                               if g in full_guids and g in el_cache)
+                transient = max(transient, seg_live)
+            resident_act += sum(
+                self.act_bytes(pcg.nodes[g], el_cache[g])
+                for g in boundary if g in full_guids and g in el_cache)
         if self.overlap:
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
         return (total_compute + total_comm + total_sync + total_update,

@@ -29,6 +29,7 @@ as first-class parallel-op PCG nodes, matching the reference's search output.
   transition costs from the Simulator            == estimate_xfer_cost
   alpha pruning + budget                         == base_optimize's prune
   memory λ binary search                         == graph_optimize_task λ loop
+  remat level (none|selective|full) per strategy == beyond ref (docs/remat.md)
   MCMC fallback                                  == FFModel::mcmc_optimize
 
 The output is a Strategy (per-op shardings) — the artifact the reference
@@ -110,6 +111,10 @@ class SearchResult:
     # (dp_dcn, tp_dcn): the DCN-spanning subfactor of each mesh axis on a
     # multi-host machine ((1, 1) = single slice)
     dcn: Tuple[int, int] = (1, 1)
+    # activation-remat level the winning plan trains under (ISSUE 3):
+    # none | selective | full — also stamped on strategy.remat so the
+    # Executor/PipelineTrainer apply the matching jax.checkpoint policy
+    remat: str = "none"
     # delta-cost engine telemetry, filled by unity_search: total search wall
     # seconds, number of costed candidates, and the Simulator's cache
     # hit/miss counters (bench.py's search_wall_s / search_candidates_per_s)
@@ -246,17 +251,20 @@ def _space_key(space: Optional[SearchSpace]) -> Tuple[bool, bool, bool, bool]:
 
 def _node_cost_entries(sim: Simulator, node: PCGNode,
                        in_shapes: List[Tuple[int, ...]], dp: int, tp: int,
-                       space: Optional[SearchSpace]):
+                       space: Optional[SearchSpace], remat: str = "none"):
     """Materialize the per-node cost table the DP mixes over: one entry
     ``(kind, in_state, out_state, time_s, resident_mem_bytes)`` per valid
     sharding option, plus the unsharded fallback row. Held in the
     Simulator's bounded LRU keyed by (op params key, in-shapes, dp, tp,
-    dcn, search-space) — guid-independent, so the 24 identical BERT layers
-    share one entry and the table survives factorization sweeps, λ
-    iterations and rewrite candidates (the delta-cost engine's unit of
-    reuse; reference analog: simulator.cc's cached task costs)."""
+    dcn, search-space, remat level) — guid-independent, so the 24
+    identical BERT layers share one entry and the table survives
+    factorization sweeps, λ iterations and rewrite candidates (the
+    delta-cost engine's unit of reuse; reference analog: simulator.cc's
+    cached task costs). The remat level shapes both sides of the entry:
+    recompute time inside ``op_cost`` (OpSharding.remat is part of ITS
+    key) and the keep-fraction-scaled resident memory."""
     key = ("dp_table", node.op.params_key(), tuple(map(tuple, in_shapes)),
-           dp, tp, sim.dp_dcn, sim.tp_dcn, _space_key(space))
+           dp, tp, sim.dp_dcn, sim.tp_dcn, _space_key(space), remat)
     hit = sim.table_get(key)
     if hit is not None:
         return hit
@@ -265,7 +273,8 @@ def _node_cost_entries(sim: Simulator, node: PCGNode,
         eff_tp = tp if kind != "none" else 1
         act_tp = tp if (kind == "none"
                         and out_state in ("S", "Q", "H")) else 1
-        sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp)
+        sh = OpSharding(dp=dp, tp=eff_tp, kind=kind, act_tp=act_tp,
+                        remat=remat)
         cm = sim.op_cost(node, in_shapes, sh)
         # liveness-aware per-node resident memory — the same per-node
         # formula Simulator.simulate's peak sums; the DP objective is a
@@ -273,19 +282,19 @@ def _node_cost_entries(sim: Simulator, node: PCGNode,
         # cannot decompose per node) and the λ loop's accept/reject uses
         # the full simulate() model, which includes it
         entries.append((kind, in_state, out_state, cm.total_time(),
-                        sim.node_resident_bytes(node, cm)))
-    sh = OpSharding(dp=dp, tp=1, kind="none")
+                        sim.node_resident_bytes(node, cm, remat)))
+    sh = OpSharding(dp=dp, tp=1, kind="none", remat=remat)
     cm = sim.op_cost(node, in_shapes, sh)
     value = (tuple(entries),
              ("none", "R", "R", cm.total_time(),
-              sim.node_resident_bytes(node, cm)))
+              sim.node_resident_bytes(node, cm, remat)))
     sim.table_put(key, value)
     return value
 
 
 def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
               batch_size: int, space: Optional[SearchSpace] = None,
-              lam: float = 1.0
+              lam: float = 1.0, remat: str = "none"
               ) -> Tuple[Dict[int, OpSharding], Dict[int, str], float]:
     """Viterbi DP over the topo order: per node, a table keyed by output
     sharding state; transitions pay resharding collectives (reference:
@@ -303,8 +312,14 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
     corrects); fan-out states are chosen by the first consumer walked back,
     other consumers pay conversions. Sink nodes are pinned to state R (the
     loss consumes replicated logits, reference: final-op label matching
-    model.cc:3090-3124)."""
-    assignment, states, _table = _dp_core(pcg, sim, dp, tp, space, lam)
+    model.cc:3090-3124).
+
+    ``remat`` (ISSUE 3) is the rematerialization level every emitted
+    OpSharding carries: the DP's per-node (time, mem) entries are priced at
+    that level, so the memory-λ mix can trade recompute flops for dropped
+    activation bytes exactly like it trades collective time for sharding."""
+    assignment, states, _table = _dp_core(pcg, sim, dp, tp, space, lam,
+                                          remat=remat)
     sim_time = simulate_best(sim, pcg, assignment, states)
     return assignment, states, sim_time
 
@@ -312,7 +327,7 @@ def dp_assign(pcg: PCG, sim: Simulator, dp: int, tp: int,
 def _dp_core(pcg: PCG, sim: Simulator, dp: int, tp: int,
              space: Optional[SearchSpace] = None, lam: float = 1.0,
              prior: Optional[Dict[int, Dict]] = None,
-             dirty: Optional[Set[int]] = None
+             dirty: Optional[Set[int]] = None, remat: str = "none"
              ) -> Tuple[Dict[int, OpSharding], Dict[int, str],
                         Dict[int, Dict]]:
     """The DP mix + backtrack behind ``dp_assign``. Returns
@@ -342,7 +357,7 @@ def _dp_core(pcg: PCG, sim: Simulator, dp: int, tp: int,
             continue
         in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
         opts, fallback = _node_cost_entries(sim, node, in_shapes, dp, tp,
-                                            space)
+                                            space, remat)
         if node.guid in sink_guids:
             opts = tuple(o for o in opts if o[2] == "R") or opts
 
@@ -431,7 +446,7 @@ def _dp_core(pcg: PCG, sim: Simulator, dp: int, tp: int,
         eff_tp = tp if kind != "none" else 1
         act_tp = tp if (kind == "none" and st in ("S", "Q", "H")) else 1
         assignment[node.guid] = OpSharding(dp=dp, tp=eff_tp, kind=kind,
-                                           act_tp=act_tp)
+                                           act_tp=act_tp, remat=remat)
         states[node.guid] = st
         for g, _ in node.inputs:
             p = pcg.nodes[g]
@@ -520,9 +535,12 @@ def pipeline_microbatch_safe(pcg: PCG, batch: int) -> bool:
 
 
 def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
-                      n_micro: int) -> Tuple[float, int]:
+                      n_micro: int, remat: str = "full"
+                      ) -> Tuple[float, int]:
     """(step time, per-chip memory) for a GPipe (pp, dp) grid with
-    ``n_micro`` microbatches.
+    ``n_micro`` microbatches, at stage-remat level ``remat`` (default
+    ``full`` — the classic GPipe recompute-the-stage recipe, and what
+    PipelineTrainer ran unconditionally before remat became leveled).
 
     The GPipe schedule is built as a TASK GRAPH and run through the SAME
     event-driven native engine that costs SPMD candidates (reference prices
@@ -541,8 +559,12 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     positions, covering pp < hosts and hosts∤pp alike.
 
     Memory = the heaviest stage's weights + grads (replicated over its dp
-    group) + one microbatch of live activations (the trainer rematerializes
-    the stage forward inside backward)."""
+    group) + one microbatch's backward-jit peak: the remat level's kept
+    residuals (keep-fraction from ``Simulator.remat_keep_fraction`` — the
+    SAME helper the SPMD memory model uses, one source of truth) plus the
+    recompute working set. Kept residuals never span microbatches here —
+    the trainer's fwd and bwd are separate jits. At ``full`` the kept term
+    is zero — the pre-leveled formula."""
     from ..ffconst import size_of_datatype
     from ..parallel.pipeline import build_stage_specs, split_stages
 
@@ -557,14 +579,18 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
     def stage_host_span(s: int) -> int:
         return ((s + 1) * dp - 1) // cph - first_host(s) + 1
 
-    # per-stage op costs, each priced at that stage's own host span
+    # per-stage op costs, each priced at that stage's own host span; the
+    # remat level rides the OpSharding so op_cost's backward includes the
+    # level's recompute (full: one extra forward per op — exactly what
+    # `stage_bwd += fwd + bwd` hand-rolled before remat was leveled)
     saved_topo = (sim.dp_dcn, sim.tp_dcn)
     stage_fwd = [0.0] * pp
-    stage_bwd = [0.0] * pp  # includes the forward remat
+    stage_bwd = [0.0] * pp  # includes the level's forward recompute
     stage_sync = [0.0] * pp
     stage_upd = [0.0] * pp
     stage_w = [0] * pp
     stage_act = [0] * pp
+    stage_keep = [0] * pp  # activations the remat level keeps resident
     try:
         for s in range(pp):
             span = stage_host_span(s) if hosts > 1 else 1
@@ -574,13 +600,22 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
                 node = pcg.nodes[g]
                 in_shapes = [pcg.nodes[gg].out_shapes[i]
                              for gg, i in node.inputs]
-                c = sim.op_cost(node, in_shapes, OpSharding(dp=dp))
+                c = sim.op_cost(node, in_shapes,
+                                OpSharding(dp=dp, remat=remat))
                 stage_fwd[s] += c.forward_time
-                stage_bwd[s] += c.forward_time + c.backward_time
+                # the trainer's bwd jit re-traces the stage forward at every
+                # level (fwd and bwd are separate jits, residuals cannot
+                # cross); under `full` op_cost already priced that recompute
+                # inside backward_time — adding it again would double-count
+                stage_bwd[s] += c.backward_time + (
+                    c.forward_time if remat != "full" else 0.0)
                 stage_sync[s] += c.sync_time
                 stage_upd[s] += c.update_time
                 stage_w[s] += c.weights_memory
-                stage_act[s] += c.inputs_memory + c.outputs_memory
+                act = c.inputs_memory + c.outputs_memory
+                stage_act[s] += act
+                stage_keep[s] += int(
+                    act * sim.remat_keep_fraction(node, remat))
     finally:
         sim.set_axis_topology(*saved_topo)
 
@@ -603,8 +638,14 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
 
     m_f = [t / max(n_micro, 1) for t in stage_fwd]
     m_b = [t / max(n_micro, 1) for t in stage_bwd]
-    mem = max(2 * w + act // max(n_micro, 1)
-              for w, act in zip(stage_w, stage_act))
+    # the trainer's fwd and bwd are separate jits, so NOTHING kept by the
+    # policy survives across microbatches — the level only changes the
+    # in-jit peak of ONE microbatch's backward: the policy's kept
+    # residuals (keep/n_micro) on top of the recompute working set
+    # (act/n_micro). At `full` (keep == 0) this reduces to the
+    # pre-leveled formula.
+    mem = max(2 * w + (keep + act) // max(n_micro, 1)
+              for w, act, keep in zip(stage_w, stage_act, stage_keep))
 
     try:
         t = _pipeline_taskgraph_makespan(pp, n_micro, m_f, m_b, bnd_micro,
@@ -1038,7 +1079,7 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                         lam: float = 1.0,
                         protected_guids: Sequence[int] = (),
                         split_threshold: int = 0,
-                        search_log=None
+                        search_log=None, remat: str = "none"
                         ) -> Tuple[PCG, Dict[int, OpSharding],
                                    Dict[int, str], float]:
     """The reference's base_optimize (substitution.cc:2229-2306): best-first
@@ -1056,7 +1097,8 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
     copied from the parent. Falls back to a full re-cost when no parent
     table is available. Under ``FLEXFLOW_TPU_SEARCH_SELFCHECK`` the delta
     result is shadowed by a full DP and asserted identical."""
-    assignment, states, table = _dp_core(pcg, sim, dp, tp, space, lam)
+    assignment, states, table = _dp_core(pcg, sim, dp, tp, space, lam,
+                                         remat=remat)
     t = simulate_best(sim, pcg, assignment, states)
     best = (pcg, assignment, states, t)
     if not xfers:
@@ -1093,10 +1135,12 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
                 explored += 1
                 dirty = _dirty_after_rewrite(g2, touched, parent_sinks)
                 a2, s2, table2 = _dp_core(g2, sim, dp, tp, space, lam,
-                                          prior=gtable, dirty=dirty)
+                                          prior=gtable, dirty=dirty,
+                                          remat=remat)
                 t2 = simulate_best(sim, g2, a2, s2)
                 if selfcheck_enabled():
-                    fa, fs, _ft = _dp_core(g2, sim, dp, tp, space, lam)
+                    fa, fs, _ft = _dp_core(g2, sim, dp, tp, space, lam,
+                                           remat=remat)
                     if (fa, fs) != (a2, s2):
                         raise AssertionError(
                             f"delta-cost selfcheck: incremental DP after "
@@ -1152,6 +1196,10 @@ def unity_search(pcg: PCG, config, n_dev: int,
             machine = TPUMachineModel.detect(n_dev)
     if sim is None:
         sim = Simulator(machine, config.search_overlap_backward_update)
+    # the simulator must price full-remat blocks at the SAME size the
+    # Executor will cut them (execution/remat.py's one-segmentation rule)
+    sim.remat_segment_size = int(
+        getattr(config, "remat_segment_size", 8) or 8)
     if calibrate:
         n_measured = sim.calibrate_from_pcg(pcg)
         _log.info("calibrated %d op shapes on device", n_measured)
@@ -1174,6 +1222,26 @@ def unity_search(pcg: PCG, config, n_dev: int,
     batch = config.batch_size
     alpha = config.search_alpha
     budget = config.search_budget if config.search_budget > 0 else 64
+
+    # rematerialization axis (ISSUE 3): `--remat` forces one level;
+    # otherwise the memory search explores every level — priced from the
+    # FIRST (λ=1.0) sweep so the λ binary search below stays a pure remix
+    # (the remat-extended tables are fully populated before any λ
+    # iteration; the zero-new-misses counter contract of ISSUE 2 holds).
+    # Without memory pressure remat only adds recompute time, so the
+    # runtime-only search keeps the single `none` level.
+    from ..execution.remat import REMAT_LEVELS
+
+    forced_remat = (getattr(config, "remat", "") or "").strip()
+    if forced_remat and forced_remat not in REMAT_LEVELS:
+        raise ValueError(
+            f"--remat {forced_remat!r} not in {REMAT_LEVELS}")
+    if forced_remat:
+        remat_levels: Tuple[str, ...] = (forced_remat,)
+    elif config.perform_memory_search:
+        remat_levels = REMAT_LEVELS
+    else:
+        remat_levels = ("none",)
 
     hbm_budget = machine.hbm_capacity
     if getattr(config, "device_memory_mb", 0):
@@ -1203,37 +1271,44 @@ def unity_search(pcg: PCG, config, n_dev: int,
                 continue
             for dp_dcn, tp_dcn in dcn_placements(dp, tp, machine.num_hosts):
                 sim.set_axis_topology(dp_dcn, tp_dcn)
-                g, a, s, t = best_first_optimize(
-                    base_pcg, sim, dp, tp, batch, xfers,
-                    budget=max(budget // 4, 4), alpha=alpha, space=space,
-                    lam=lam, protected_guids=protected_guids,
-                    split_threshold=getattr(config,
-                                            "base_optimize_threshold", 0),
-                    search_log=slog)
-                _, mem = sim.simulate(g, a, s)
-                _log.info(
-                    "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f -> %.3f ms, "
-                    "%.1f MiB/chip", dp, tp, dp_dcn, tp_dcn, lam, t * 1e3,
-                    mem / 2 ** 20)
-                feasible = mem_budget is None or mem <= mem_budget
-                accepted = feasible and t < sweep_best[0]
-                if accepted:
-                    sweep_best[0] = t
-                slog.log(event="candidate", dp=dp, tp=tp,
-                         dcn=[dp_dcn, tp_dcn], lam=round(lam, 4),
-                         cost_ms=round(t * 1e3, 4),
-                         mem_mib=round(mem / 2 ** 20, 1),
-                         feasible=bool(feasible), accepted=bool(accepted),
-                         best_ms=round(
-                             (sweep_best[0] if sweep_best[0] != float("inf")
-                              else t) * 1e3, 4))
-                results.append(SearchResult(
-                    strategy=assignment_to_strategy(
+                for remat in remat_levels:
+                    g, a, s, t = best_first_optimize(
+                        base_pcg, sim, dp, tp, batch, xfers,
+                        budget=max(budget // 4, 4), alpha=alpha, space=space,
+                        lam=lam, protected_guids=protected_guids,
+                        split_threshold=getattr(config,
+                                                "base_optimize_threshold",
+                                                0),
+                        search_log=slog, remat=remat)
+                    _, mem = sim.simulate(g, a, s)
+                    _log.info(
+                        "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f remat=%s -> "
+                        "%.3f ms, %.1f MiB/chip", dp, tp, dp_dcn, tp_dcn,
+                        lam, remat, t * 1e3, mem / 2 ** 20)
+                    feasible = mem_budget is None or mem <= mem_budget
+                    accepted = feasible and t < sweep_best[0]
+                    if accepted:
+                        sweep_best[0] = t
+                    slog.log(event="candidate", dp=dp, tp=tp,
+                             dcn=[dp_dcn, tp_dcn], lam=round(lam, 4),
+                             remat=remat,
+                             cost_ms=round(t * 1e3, 4),
+                             mem_mib=round(mem / 2 ** 20, 1),
+                             feasible=bool(feasible),
+                             accepted=bool(accepted),
+                             best_ms=round(
+                                 (sweep_best[0]
+                                  if sweep_best[0] != float("inf")
+                                  else t) * 1e3, 4))
+                    strat = assignment_to_strategy(
                         g, a, s, dp, tp, machine=machine,
-                        dcn=(dp_dcn, tp_dcn)),
-                    assignment=a, sim_time=t, sim_memory=mem,
-                    mesh_shape=(dp, tp), pcg=g, states=s,
-                    dcn=(dp_dcn, tp_dcn)))
+                        dcn=(dp_dcn, tp_dcn))
+                    strat.remat = remat
+                    results.append(SearchResult(
+                        strategy=strat,
+                        assignment=a, sim_time=t, sim_memory=mem,
+                        mesh_shape=(dp, tp), pcg=g, states=s,
+                        dcn=(dp_dcn, tp_dcn), remat=remat))
         sim.set_axis_topology(1, 1)
         if not results:
             return None
@@ -1244,7 +1319,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
         else:
             chosen = min(results, key=lambda r: r.sim_time)
         slog.log(event="sweep_result", lam=round(lam, 4),
-                 mesh=list(chosen.mesh_shape),
+                 mesh=list(chosen.mesh_shape), remat=chosen.remat,
                  cost_ms=round(chosen.sim_time * 1e3, 4),
                  mem_mib=round(chosen.sim_memory / 2 ** 20, 1),
                  feasible=bool(mem_budget is None
@@ -1296,6 +1371,13 @@ def unity_search(pcg: PCG, config, n_dev: int,
             # batch % n_dev: the companion eval/predict strategy is DP
             # over all n_dev devices — same guard search_all applies
             n_nodes = len(base_pcg.compute_nodes())
+            # stage remat is leveled too (PipelineTrainer runs the same
+            # policy machinery): a forced level wins; the memory search
+            # explores all levels; otherwise keep the classic GPipe full
+            # remat the trainer always ran pre-leveling
+            pipe_levels = ((forced_remat,) if forced_remat
+                           else remat_levels
+                           if config.perform_memory_search else ("full",))
             for pp in (2, 4, 8):
                 if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
                     continue
@@ -1305,31 +1387,36 @@ def unity_search(pcg: PCG, config, n_dev: int,
                               (batch // m) % max(pdp, 1) == 0), None)
                 if micro is None:
                     continue
-                t_pipe, m_pipe = simulate_pipeline(sim, base_pcg, pp, pdp,
-                                                   micro)
-                _log.info("pipeline pp=%d dp=%d m=%d -> %.3f ms, %.1f MiB",
-                          pp, pdp, micro, t_pipe * 1e3, m_pipe / 2 ** 20)
-                # accepted must mirror the ACTUAL decision below, memory
-                # budget included, or replaying the log reconstructs a
-                # different search than the one that ran
-                pipe_ok = t_pipe < best.sim_time and (
-                    not config.perform_memory_search or
-                    m_pipe <= hbm_budget)
-                slog.log(event="pipeline_candidate", pp=pp, dp=pdp,
-                         n_micro=micro, cost_ms=round(t_pipe * 1e3, 4),
-                         mem_mib=round(m_pipe / 2 ** 20, 1),
-                         accepted=bool(pipe_ok),
-                         best_ms=round((t_pipe if pipe_ok
-                                        else best.sim_time) * 1e3, 4))
-                if pipe_ok:
-                    from ..parallel.strategy import data_parallel_strategy
+                for lv in pipe_levels:
+                    t_pipe, m_pipe = simulate_pipeline(sim, base_pcg, pp,
+                                                       pdp, micro, remat=lv)
+                    _log.info("pipeline pp=%d dp=%d m=%d remat=%s -> "
+                              "%.3f ms, %.1f MiB", pp, pdp, micro, lv,
+                              t_pipe * 1e3, m_pipe / 2 ** 20)
+                    # accepted must mirror the ACTUAL decision below,
+                    # memory budget included, or replaying the log
+                    # reconstructs a different search than the one that ran
+                    pipe_ok = t_pipe < best.sim_time and (
+                        not config.perform_memory_search or
+                        m_pipe <= hbm_budget)
+                    slog.log(event="pipeline_candidate", pp=pp, dp=pdp,
+                             n_micro=micro, remat=lv,
+                             cost_ms=round(t_pipe * 1e3, 4),
+                             mem_mib=round(m_pipe / 2 ** 20, 1),
+                             accepted=bool(pipe_ok),
+                             best_ms=round((t_pipe if pipe_ok
+                                            else best.sim_time) * 1e3, 4))
+                    if pipe_ok:
+                        from ..parallel.strategy import \
+                            data_parallel_strategy
 
-                    strat = data_parallel_strategy(pcg, n_dev)
-                    strat.pipeline = (pp, pdp, micro)
-                    best = SearchResult(
-                        strategy=strat, assignment={}, sim_time=t_pipe,
-                        sim_memory=m_pipe, mesh_shape=(n_dev, 1),
-                        pcg=None, states=None)
+                        strat = data_parallel_strategy(pcg, n_dev)
+                        strat.pipeline = (pp, pdp, micro)
+                        strat.remat = lv
+                        best = SearchResult(
+                            strategy=strat, assignment={}, sim_time=t_pipe,
+                            sim_memory=m_pipe, mesh_shape=(n_dev, 1),
+                            pcg=None, states=None, remat=lv)
 
     # delta-cost engine telemetry: wall time, throughput and cache counters
     # land on the SearchResult (bench.py's search_wall_s metric) and in the
@@ -1353,7 +1440,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
         best.cache_stats = cache_stats
         slog.log(event="result", cost_ms=round(best.sim_time * 1e3, 4),
                  mem_mib=round(best.sim_memory / 2 ** 20, 1),
-                 mesh=list(best.mesh_shape),
+                 mesh=list(best.mesh_shape), remat=best.remat,
                  pipeline=(list(best.strategy.pipeline)
                            if getattr(best.strategy, "pipeline", None)
                            else None),

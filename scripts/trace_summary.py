@@ -141,6 +141,17 @@ def summarize_jsonl(records, top: int) -> None:
         if final:
             print(f"result: {json.dumps(final[-1])}")
             r = final[-1]
+            if "mesh" in r or "remat" in r or r.get("pipeline"):
+                # the searched plan in one line: mesh, GPipe grid (if any)
+                # and the activation-remat level (ISSUE 3)
+                bits = []
+                if r.get("mesh"):
+                    bits.append(f"mesh={tuple(r['mesh'])}")
+                if r.get("pipeline"):
+                    pp, pdp, m = r["pipeline"]
+                    bits.append(f"pipeline pp={pp} dp={pdp} n_micro={m}")
+                bits.append(f"remat={r.get('remat', 'none')}")
+                print("searched plan: " + "  ".join(bits))
             if r.get("search_wall_s") is not None:
                 # delta-cost engine headline: throughput + cache hit rate
                 print(f"delta-cost engine: {r.get('candidates', '?')} "

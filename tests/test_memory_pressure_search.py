@@ -4,9 +4,11 @@ memory-aware search, /root/reference/src/runtime/graph.cc:2060-2133).
 BERT-Large at batch 512 needs ~19.4 GiB/chip under pure DP-8 by the
 grounded memory model — infeasible on v5e's 16 GiB. The search must find a
 feasible strategy itself. Activations dominate and shard identically under
-every (dp, tp) factorization, so the real escape is GPipe microbatching
-(live activations / n_micro); bench.py's memsearch leg records the same
-regime and the dryrun executes a budget-forced winner end-to-end."""
+every (dp, tp) factorization, so the escapes are GPipe microbatching (live
+activations / n_micro) and — since ISSUE 3 — activation rematerialization
+(saved bytes x keep-fraction, a few percent recompute); bench.py's
+memsearch leg records the same regime and the dryrun executes a
+budget-forced winner end-to-end."""
 from flexflow_tpu import FFConfig, FFModel
 from flexflow_tpu.models.bert import BertConfig, build_bert
 from flexflow_tpu.search.machine_model import TPUMachineModel
@@ -36,8 +38,12 @@ def test_search_escapes_infeasible_dp_on_bert_large():
                        return_result=True, insert_ir_nodes=False, sim=sim)
     assert res.sim_memory <= machine.hbm_capacity, \
         (res.sim_memory, machine.hbm_capacity)
-    # the winner is a genuine strategy change, not DP-with-fingers-crossed
+    # the winner is a genuine strategy change, not DP-with-fingers-crossed:
+    # a GPipe grid, a model-parallel mesh, or a remat level that drops the
+    # saved activations (the ISSUE 3 axis — cheaper than the bubble here)
     assert getattr(res.strategy, "pipeline", None) is not None or \
-        res.mesh_shape[1] > 1, (res.mesh_shape, res.strategy.pipeline)
+        res.mesh_shape[1] > 1 or \
+        getattr(res, "remat", "none") != "none", \
+        (res.mesh_shape, res.strategy.pipeline, res.remat)
     # and it reports a finite simulated time for the feasible plan
     assert res.sim_time > 0

@@ -1,0 +1,343 @@
+"""Searchable activation rematerialization (ISSUE 3).
+
+Fast tier: numerics equivalence (gradients under `full`/`selective`
+jax.checkpoint policies match the no-remat baseline exactly — recompute
+replays the same ops with the same folded RNG), XLA-peak decrease under
+`full` remat on a seq-scaled model, cost-model/plan plumbing, and the
+λ-remix counter contract with remat-extended keys.
+
+Slow tier (marked): the BERT-Large 8-dev remat × memory-search sweep — the
+bench acceptance leg (dp8+remat beats the pipeline bubble) under the
+FLEXFLOW_TPU_SEARCH_SELFCHECK equivalence gate.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+from flexflow_tpu.execution.remat import (REMAT_LEVELS, RematPlan,
+                                          remat_segments,
+                                          resolve_remat_plan,
+                                          resolve_stage_remat)
+from flexflow_tpu.models.bert import BertConfig, build_bert
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import (SELFCHECK_ENV, OpSharding,
+                                           Simulator)
+from flexflow_tpu.search.unity import dp_assign, unity_search
+
+
+def _compiled_bert(cfg, remat=""):
+    config = FFConfig()
+    config.batch_size = cfg.batch_size
+    config.remat = remat
+    ff = FFModel(config)
+    build_bert(ff, cfg)
+    ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-3),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _batch(cfg, rng=None):
+    rng = rng or np.random.default_rng(0)
+    x = [rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
+                    ).astype(np.float32)]
+    y = rng.integers(0, cfg.num_classes,
+                     size=(cfg.batch_size, 1)).astype(np.int32)
+    return x, y
+
+
+# ------------------------------------------------------------- numerics
+def test_remat_gradients_match_no_remat_baseline():
+    """One full train step (loss + grads + Adam update) from identical
+    params under each policy: losses and updated params must match the
+    baseline — remat changes WHAT is saved, never what is computed."""
+    import jax
+    import jax.random as jr
+
+    cfg = BertConfig.tiny(batch_size=4)
+    x, y = _batch(cfg)
+    outs = {}
+    for level in ("", "selective", "full"):
+        ff = _compiled_bert(cfg, remat=level)
+        step = ff.executor.make_train_step()
+        p, _o, loss, _m = step(ff.params, ff.opt_state, x, y, jr.PRNGKey(7))
+        outs[level or "none"] = (float(loss), jax.tree_util.tree_leaves(p))
+        if level:
+            assert ff.executor.remat_plan is not None \
+                and ff.executor.remat_plan.level == level
+        else:
+            assert ff.executor.remat_plan is None
+    base_loss, base_leaves = outs["none"]
+    for level in ("selective", "full"):
+        loss, leaves = outs[level]
+        assert np.allclose(loss, base_loss, rtol=1e-6), level
+        for a, b in zip(leaves, base_leaves):
+            assert np.allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6), level
+
+
+def test_remat_xla_peak_strictly_decreases():
+    """Seq-scaled config (activations dominate weights): XLA's compiled
+    peak must strictly drop under `full` remat and not grow under
+    `selective` — the measured effect the analytic model prices."""
+    import jax
+
+    from flexflow_tpu.obs.telemetry import peak_memory_bytes
+
+    cfg = BertConfig(batch_size=2, seq_len=512, hidden=128, num_heads=4,
+                     num_layers=4, intermediate=512)
+    x, y = _batch(cfg)
+    peaks = {}
+    analytic = {}
+    for level in ("", "selective", "full"):
+        ff = _compiled_bert(cfg, remat=level)
+        xd = [jax.device_put(a) for a in x]
+        yd = jax.device_put(y)
+        ma = ff.executor.train_step_memory_analysis(ff.params, ff.opt_state,
+                                                    xd, yd)
+        peaks[level or "none"] = peak_memory_bytes(ma)
+        sim = Simulator(TPUMachineModel.from_generation("v5e", 1))
+        asg = {n.guid: OpSharding(dp=1, remat=level or "none")
+               for n in ff.pcg.compute_nodes()}
+        _, analytic[level or "none"] = sim.simulate(ff.pcg, asg, {})
+    assert all(peaks.values()), peaks
+    assert peaks["full"] < peaks["none"], peaks
+    assert peaks["selective"] <= peaks["none"], peaks
+    # analytic deltas track XLA's in SIGN and rough magnitude. The tight
+    # within-2x band is asserted against CHIP peaks by bench.py's
+    # memsearch_remat_leg (mem_remat_delta_analytic_vs_xla_*) — CPU buffer
+    # assignment differs enough that only a loose band is stable here
+    # (same caveat as test_memory_model.py's pinned-chip-numbers note)
+    d_xla = peaks["none"] - peaks["full"]
+    d_an = analytic["none"] - analytic["full"]
+    assert d_an > 0
+    assert 0.25 <= d_an / d_xla <= 4.0, (d_an, d_xla)
+
+
+# ------------------------------------------------------------ plumbing
+def test_remat_segments_partition_compute_nodes():
+    ff = _compiled_bert(BertConfig.tiny(batch_size=4))
+    pcg = ff.pcg
+    segs = remat_segments(pcg, segment_size=4)
+    flat = [g for seg in segs for g in seg]
+    assert flat == [n.guid for n in pcg.compute_nodes()]  # ordered cover
+    assert len(segs) >= 2  # tiny BERT still splits at layer bottlenecks
+
+
+def test_remat_plan_resolution_and_validation():
+    config = FFConfig()
+    strategy = type("S", (), {"remat": "selective"})()
+    assert resolve_remat_plan(config, strategy).level == "selective"
+    config.remat = "full"  # the flag wins over the searched level
+    assert resolve_remat_plan(config, strategy).level == "full"
+    assert resolve_stage_remat(config, strategy) == "full"
+    config.remat = ""
+    # UNSET (strategy.remat == "" — imported/unsearched) keeps the classic
+    # defaults: executor blocks none, pipeline stages full; an explicit
+    # searched "none" turns stage remat off — the two must not conflate
+    unset = type("S", (), {"remat": ""})()
+    assert resolve_remat_plan(config, unset).level == "none"
+    assert resolve_stage_remat(config, unset) == "full"
+    assert resolve_stage_remat(config, type("S", (), {})()) == "full"
+    searched_none = type("S", (), {"remat": "none"})()
+    assert resolve_stage_remat(config, searched_none) == "none"
+    with pytest.raises(ValueError):
+        RematPlan(level="bogus")
+    with pytest.raises(ValueError):
+        FFConfig().parse_args(["--remat", "bogus"])
+
+
+def test_strategy_json_roundtrip_carries_remat():
+    from flexflow_tpu.parallel.strategy import Strategy
+
+    ff = _compiled_bert(BertConfig.tiny(batch_size=4))
+    s = ff.strategy
+    s.remat = "selective"
+    s2 = Strategy.from_json(s.to_json(ff.pcg), ff.pcg)
+    assert s2.remat == "selective"
+
+
+# ----------------------------------------------------------- cost model
+def test_op_cost_remat_levels_are_distinct_cache_entries():
+    """OpSharding.remat is part of the op-cost key: `full` prices the
+    recompute in backward; `selective` keeps contraction outputs (no
+    recompute for a Linear) but zeroes a Gelu's resident activation."""
+    ff = _compiled_bert(BertConfig.tiny(batch_size=4))
+    pcg = ff.pcg
+    sim = Simulator(TPUMachineModel.from_generation("v5e", 8))
+    from flexflow_tpu.execution.remat import REMAT_SAVEABLE_OPS
+
+    lin = next(n for n in pcg.compute_nodes()
+               if n.op.op_type.name == "OP_LINEAR")
+    act = next(n for n in pcg.compute_nodes()  # cheap non-contraction op
+               if n.op.op_type not in REMAT_SAVEABLE_OPS)
+    for node in (lin, act):
+        shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+        c_none = sim.op_cost(node, shapes, OpSharding(dp=8))
+        c_sel = sim.op_cost(node, shapes, OpSharding(dp=8,
+                                                     remat="selective"))
+        c_full = sim.op_cost(node, shapes, OpSharding(dp=8, remat="full"))
+        assert c_full.backward_time > c_none.backward_time  # recompute
+        is_dot = node is lin
+        assert (c_sel.backward_time == c_none.backward_time) == is_dot
+        keep_sel = sim.remat_keep_fraction(node, "selective")
+        assert keep_sel == (1.0 if is_dot else 0.0)
+        assert sim.node_resident_bytes(node, c_sel, "selective") <= \
+            sim.node_resident_bytes(node, c_none, "none")
+    assert sim.cost_cache_misses == 6  # 2 nodes x 3 levels, no collisions
+
+
+def test_simulate_memory_drops_with_remat_level():
+    ff = _compiled_bert(BertConfig.tiny(batch_size=4))
+    pcg = ff.pcg
+    sim = Simulator(TPUMachineModel.from_generation("v5e", 8))
+    mems = {}
+    times = {}
+    for level in REMAT_LEVELS:
+        asg = {n.guid: OpSharding(dp=8, remat=level)
+               for n in pcg.compute_nodes()}
+        times[level], mems[level] = sim.simulate(pcg, asg, {})
+    assert mems["full"] < mems["selective"] < mems["none"]
+    assert times["full"] > times["none"]  # recompute is not free
+
+
+def test_lambda_remix_stays_pure_with_remat_levels():
+    """The ISSUE 2 counter contract with remat-extended keys: after each
+    level's tables are populated at λ=1, λ re-runs at ANY level make zero
+    new op_cost calls."""
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    build_bert(ff, BertConfig.tiny(batch_size=8))
+    pcg = ff.create_pcg()
+    sim = Simulator(TPUMachineModel.from_generation("v5e", 8))
+    for level in REMAT_LEVELS:
+        dp_assign(pcg, sim, 2, 4, 8, lam=1.0, remat=level)
+    misses0 = sim.cost_cache_misses
+    hits0 = sim.cost_cache_hits
+    for lam in (0.75, 0.5, 0.0):
+        for level in REMAT_LEVELS:
+            dp_assign(pcg, sim, 2, 4, 8, lam=lam, remat=level)
+    assert sim.cost_cache_misses == misses0, "remat λ remix re-costed ops"
+    assert sim.cost_cache_hits > hits0
+
+
+# ------------------------------------------------------ searched axis
+def test_memory_search_with_remat_axis_finds_feasible_cheaper_plan(
+        monkeypatch):
+    """Under memory pressure the remat-extended search must stay feasible
+    and be at least as fast as a search forced to remat=none — the axis
+    can only add options. Selfcheck gate active throughout."""
+    monkeypatch.setenv(SELFCHECK_ENV, "1")
+    m = TPUMachineModel.from_generation("v5e", 8)
+
+    def run(forced):
+        config = FFConfig()
+        config.batch_size = 2048
+        from flexflow_tpu import ActiMode
+
+        ff = FFModel(config)
+        x = ff.create_tensor((2048, 1024))
+        t = x
+        for _ in range(3):
+            t = ff.dense(t, 1024, ActiMode.AC_MODE_RELU)
+        ff.softmax(ff.dense(t, 8))
+        ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        pcg = ff.create_pcg()
+        config.device_memory_mb = 25
+        config.perform_memory_search = True
+        config.remat = forced
+        return unity_search(pcg.copy(), config, 8, machine=m,
+                            return_result=True, insert_ir_nodes=False)
+
+    res = run("")
+    res_none = run("none")
+    budget = 25 * 2 ** 20
+    assert res.sim_memory <= budget
+    assert res.remat in REMAT_LEVELS
+    assert res.strategy.remat == res.remat
+    assert res_none.remat == "none"
+    assert res.sim_time <= res_none.sim_time * (1 + 1e-9)
+
+
+@pytest.mark.slow
+def test_bert_large_8dev_remat_beats_pipeline_bubble(monkeypatch):
+    """The bench acceptance leg (ISSUE 3): BERT-Large b512 on 8 v5e chips —
+    dp8 needs 19.45 GiB (infeasible); pre-remat the search fell back to a
+    GPipe plan 1.8x slower than dp8 (memsearch_vs_dp_time 0.547 in
+    BENCH_r05). With the remat axis the winner must be feasible AND
+    markedly closer to dp8 speed, under the selfcheck gate, with the λ
+    sweeps still pure remixes."""
+    import json
+
+    monkeypatch.setenv(SELFCHECK_ENV, "1")
+    from flexflow_tpu.search.unity import simulate_best
+
+    config = FFConfig()
+    config.batch_size = 512
+    config.perform_memory_search = True
+    ff = FFModel(config)
+    build_bert(ff, BertConfig(batch_size=512, seq_len=512, hidden=1024,
+                              num_heads=16, num_layers=24,
+                              intermediate=4096))
+    pcg = ff.create_pcg()
+    machine = TPUMachineModel.from_generation("v5e", 8)
+    sim = Simulator(machine)
+    sim.activation_el = 2
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as log:
+        config.search_log_file = log.name
+        res = unity_search(pcg.copy(), config, 8, machine=machine,
+                           return_result=True, insert_ir_nodes=False,
+                           sim=sim)
+        records = [json.loads(line) for line in log.read().splitlines()]
+    dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
+    _, mem_dp = sim.simulate(pcg, dp8, {})
+    t_dp = simulate_best(sim, pcg, dp8, {})
+    assert mem_dp > machine.hbm_capacity  # the pressure is real
+    assert res.sim_memory <= machine.hbm_capacity
+    assert res.remat != "none"  # remat is the chosen escape, not GPipe
+    assert getattr(res.strategy, "pipeline", None) is None
+    # 0.547 was the pipeline plan's ratio; remat recompute costs a few
+    # percent, not a bubble
+    assert t_dp / res.sim_time > 0.85
+    # λ binary-search sweeps after the first stayed pure remixes
+    sweeps = [r for r in records if r.get("event") == "sweep_result"]
+    assert len(sweeps) >= 2
+    misses = [r["cost_cache_misses"] for r in sweeps]
+    assert all(mi == misses[0] for mi in misses[1:]), misses
+    # the result record reports the plan (trace_summary prints it)
+    result = [r for r in records if r.get("event") == "result"][-1]
+    assert result["remat"] == res.remat
+
+
+def test_pipeline_trainer_leveled_remat_numerics():
+    """PipelineTrainer under none/selective/full stage remat: identical
+    losses — the policy machinery changes saved bytes, not math."""
+    from flexflow_tpu import ActiMode, SGDOptimizer
+    from flexflow_tpu.parallel.pipeline import PipelineTrainer
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32))
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(3)
+    xb = rng.normal(size=(8, 32)).astype(np.float32)
+    yb = rng.integers(0, 4, size=(8, 1)).astype(np.int32)
+    losses = {}
+    for level in REMAT_LEVELS:
+        # ONE model, one param set: trainers seed from the same compiled
+        # params (fresh FFModels re-roll guids and with them the init RNG)
+        tr = PipelineTrainer(
+            ff, pp=2, dp=1, n_micro=2, optimizer=SGDOptimizer(ff, lr=0.1),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            init_params=False, remat=level)
+        tr.load_params(ff.params)
+        losses[level] = tr.train_step(xb, yb, rng_seed=0)
+    assert np.allclose(losses["selective"], losses["none"], rtol=1e-6)
+    assert np.allclose(losses["full"], losses["none"], rtol=1e-6)
