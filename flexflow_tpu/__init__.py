@@ -21,5 +21,8 @@ from .execution.initializers import (GlorotUniformInitializer,  # noqa: F401
                                      UniformInitializer, NormInitializer)
 
 from .parallel.pipeline import PipelineTrainer  # noqa: F401,E402
+from .execution.checkpoint import (latest_checkpoint,  # noqa: F401,E402
+                                   restore_checkpoint, save_checkpoint)
+from .resilience import ChaosPlan, elastic_restore  # noqa: F401,E402
 
 __version__ = "0.1.0"

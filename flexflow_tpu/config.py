@@ -127,6 +127,25 @@ class FFConfig:
     # that role; this adds jax_debug_nans on top)
     debug_nans: bool = False
 
+    # fault tolerance (flexflow_tpu/resilience, docs/fault_tolerance.md).
+    # The reference inherits resilience from Legion's task runtime; here it
+    # is a first-class subsystem: preemption-safe async checkpoints,
+    # divergence sentinels with rollback, elastic degraded-mesh restart.
+    checkpoint_dir: str = ""     # atomic committed checkpoints land here
+    checkpoint_every: int = 0    # steps between async checkpoints; 0 = off
+    keep_checkpoints: int = 3    # retention: newest N committed kept
+    # divergence sentinel: after this many CONSECUTIVE non-finite steps
+    # (NaN/Inf loss or grad) auto-restore the last committed checkpoint;
+    # 0 disables guarding (no per-step scalar transfer)
+    max_bad_steps: int = 0
+    # "auto" resumes from the newest committed checkpoint in
+    # checkpoint_dir; a path resumes from exactly that checkpoint
+    resume: str = ""
+    # reduced-LR escape hatch: LR multiplier applied when divergence
+    # persists past the first rollback; hard stop after max_rollbacks
+    rollback_lr_factor: float = 0.5
+    max_rollbacks: int = 3
+
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
     mesh_axis_names: Sequence[str] = ("data", "model")
@@ -246,6 +265,20 @@ class FFConfig:
                 self.profiling = True
             elif a == "--debug-nans":
                 self.debug_nans = True
+            elif a == "--checkpoint-dir":
+                self.checkpoint_dir = _next()
+            elif a == "--checkpoint-every":
+                self.checkpoint_every = int(_next())
+            elif a == "--keep-checkpoints":
+                self.keep_checkpoints = int(_next())
+            elif a == "--max-bad-steps":
+                self.max_bad_steps = int(_next())
+            elif a == "--resume":
+                self.resume = _next()
+            elif a == "--rollback-lr-factor":
+                self.rollback_lr_factor = float(_next())
+            elif a == "--max-rollbacks":
+                self.max_rollbacks = int(_next())
             elif a == "--taskgraph":
                 self.export_strategy_task_graph_file = _next()
             elif a == "--include-costs-dot-graph":

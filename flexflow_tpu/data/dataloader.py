@@ -48,17 +48,28 @@ class SingleDataLoader:
 
 def batch_iterator(arrays: Sequence[np.ndarray], batch_size: int,
                    shuffle: bool = False, seed: int = 0,
-                   drop_remainder: bool = True) -> Iterator[List[np.ndarray]]:
+                   drop_remainder: bool = True,
+                   start_batch: int = 0) -> Iterator[List[np.ndarray]]:
+    """``start_batch`` skips the first k batches of the (seed-determined)
+    stream without materializing them — the exact-resume path: a run
+    restored mid-epoch replays the same shuffle and continues at the batch
+    cursor the checkpoint recorded (resilience/session.py)."""
     n = arrays[0].shape[0]
     idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    if start_batch > 0:
+        # trim AFTER the shuffle: the remaining stream is identical to the
+        # tail of an uninterrupted epoch at the same seed
+        idx = idx[start_batch * batch_size:]
+    m = len(idx)
     if shuffle:
         # native double-buffered staging: C++ gathers batch b+1 while batch b
         # ships to the device (flexflow_tpu/native BatchPipeline; falls back
         # to synchronous gather without the library)
         from ..native import BatchPipeline
 
-        np.random.default_rng(seed).shuffle(idx)
-        if drop_remainder or n % batch_size == 0:
+        if drop_remainder or m % batch_size == 0:
             yield from BatchPipeline(arrays, idx, batch_size)
             return
         from ..native import gather_rows
@@ -68,7 +79,7 @@ def batch_iterator(arrays: Sequence[np.ndarray], batch_size: int,
     else:
         def take(a, sl):
             return a[sl]
-    nb = n // batch_size if drop_remainder else -(-n // batch_size)
+    nb = m // batch_size if drop_remainder else -(-m // batch_size)
     for b in range(nb):
         sl = idx[b * batch_size:(b + 1) * batch_size]
         yield [take(a, sl) for a in arrays]

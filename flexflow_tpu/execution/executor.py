@@ -41,6 +41,7 @@ class Executor:
         self.repl_labels = repl_labels
 
         self._train_step = None
+        self._guarded_train_step = None
         self._eval_step = None
         self._forward_jit = None
         # the RematPlan make_train_step resolved and applied (None until
@@ -336,7 +337,17 @@ class Executor:
         return cache
 
     # --------------------------------------------------------------- train step
-    def make_train_step(self):
+    def invalidate_jit_cache(self) -> None:
+        """Drop every cached jitted function. Required after anything the
+        jits bake in as a constant changes — an optimizer learning-rate
+        edit (keras LR scheduler, the sentinel's reduced-LR rollback) or
+        an op-attr mutation outside recompile()."""
+        self._train_step = None
+        self._guarded_train_step = None
+        self._eval_step = None
+        self._forward_jit = None
+
+    def make_train_step(self, guard: bool = False):
         """One fused jitted step: forward + loss + grad + metrics + update
         (SURVEY §7 hard-part 6 — the reference's separate
         zero_gradients/forward/backward/update phases collapse into this).
@@ -350,11 +361,19 @@ class Executor:
         forward through checkpointed remat blocks — ``jax.checkpoint``
         with the leveled save policy over bottleneck-cut segments — so the
         saved-for-backward set shrinks to what the plan keeps. Donation
-        and the per-op named_scope observability are unchanged."""
+        and the per-op named_scope observability are unchanged.
+
+        Divergence sentinel (ISSUE 4): with ``guard=True`` the step checks
+        ``isfinite(loss) & isfinite(|grad|²)`` on device and applies the
+        optimizer update under ``lax.cond`` — a non-finite step returns
+        params/opt_state UNCHANGED (the poison never reaches the weights)
+        plus a trailing ``ok`` bool scalar, the single value the host-side
+        ``resilience.GuardedTrainStep`` transfers per step."""
         import jax
 
-        if self._train_step is not None:
-            return self._train_step
+        cached = self._guarded_train_step if guard else self._train_step
+        if cached is not None:
+            return cached
 
         mesh = self.mesh
         opt = self.optimizer
@@ -400,15 +419,37 @@ class Executor:
         def step(params, opt_state, xs, labels, rng, cache=None):
             (loss, (logits, cache_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, xs, labels, rng, cache)
-            new_params, new_state = opt.update(params, grads, opt_state)
+            if guard:
+                import jax.numpy as jnp
+
+                # one reduction over all grads: any NaN/Inf anywhere in the
+                # gradient (or the loss) poisons the scalar, so a single
+                # isfinite pair is the whole check
+                leaves = jax.tree_util.tree_leaves(grads)
+                gsq = (sum(jnp.vdot(g, g) for g in leaves)
+                       if leaves else jnp.zeros((), jnp.float32))
+                ok = jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(gsq))
+                new_params, new_state = jax.lax.cond(
+                    ok,
+                    lambda: opt.update(params, grads, opt_state),
+                    lambda: (params, opt_state))
+            else:
+                new_params, new_state = opt.update(params, grads, opt_state)
             m = self._compute_metrics(logits, labels)
+            out = (new_params, new_state, loss, m)
             if has_cache:
-                return new_params, new_state, loss, m, cache_out
-            return new_params, new_state, loss, m
+                out = out + (cache_out,)
+            if guard:
+                out = out + (ok,)
+            return out
 
         jit_kwargs = {"donate_argnums": (0, 1)}
-        self._train_step = jax.jit(step, **jit_kwargs)
-        return self._train_step
+        fn = jax.jit(step, **jit_kwargs)
+        if guard:
+            self._guarded_train_step = fn
+        else:
+            self._train_step = fn
+        return fn
 
     def train_step_memory_analysis(self, params, opt_state, xs, labels):
         """XLA's compiled memory stats for the full training step

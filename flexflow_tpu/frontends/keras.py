@@ -378,10 +378,15 @@ class _BaseModel:
                 cb.on_epoch_begin(epoch)
             opt = self.ffmodel.optimizer
             if getattr(opt, "_lr_changed", False):
-                # jitted step baked the old rate in as a constant; rebuild
-                self.ffmodel.executor._train_step = None
+                # jitted steps baked the old rate in as a constant; rebuild
+                # them all (the guarded sentinel variant included)
+                self.ffmodel.executor.invalidate_jit_cache()
                 opt._lr_changed = False
             perf = self.ffmodel.fit(x, y, batch_size=batch_size, epochs=1)
+            if getattr(self.ffmodel, "_preempted_at_step", None) is not None:
+                # the inner fit flushed its preemption checkpoint and
+                # returned; looping on would burn the grace window
+                break
             stop = False
             for cb in callbacks:
                 if cb.on_epoch_end(epoch):

@@ -586,6 +586,12 @@ class FFModel:
             jax.config.update("jax_debug_nans", True)
         devices = jax.devices()
         n_dev = len(devices)
+        # elastic restart (resilience/elastic.py): a degraded-topology
+        # restore re-plans for the SURVIVING device count, which may be a
+        # strict subset of what this host still enumerates
+        elastic_n = getattr(self, "_elastic_n_dev", None)
+        if elastic_n:
+            n_dev = min(int(elastic_n), n_dev)
         if strategy_fn is not None:
             strategy = strategy_fn(pcg)
         if strategy is not None:
@@ -777,9 +783,12 @@ class FFModel:
             return data_parallel_strategy(pcg, n_dev)
         # the final (loss-anchored) node must survive graph rewrites so the
         # label tensor and executor anchor stay valid (the reference protects
-        # its sink the same way via the output-shape contract)
+        # its sink the same way via the output-shape contract).
+        # _search_sim: an elastic restart hands the previous search's warm
+        # Simulator in so the re-plan reuses its memoized delta-cost tables
         return unity_search(pcg, self.config, n_dev,
-                            protected_guids=(self.final_guid,))
+                            protected_guids=(self.final_guid,),
+                            sim=getattr(self, "_search_sim", None))
 
     # ============================================================ training ==
     def _next_rng(self):
@@ -804,7 +813,8 @@ class FFModel:
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, callbacks=None,
-            recompile_state=None, shuffle: bool = True) -> PerfMetrics:
+            recompile_state=None, shuffle: bool = True,
+            chaos=None) -> PerfMetrics:
         """Training loop (reference: flexflow_cffi.py:2058-2100 — per batch:
         next_batch -> forward -> zero_gradients -> backward -> update inside a
         Legion trace; here one fused jitted step per batch).
@@ -814,7 +824,18 @@ class FFModel:
         scores land in ``self.cache_scores`` — the signal the MoE
         cache/recompile pairing consumes (reference: cache.cc:291 +
         moe.cc:180,204). ``recompile_state`` hooks the per-iteration dynamic
-        recompile check (FFModel::recompile_on_condition, model.cc:2422)."""
+        recompile check (FFModel::recompile_on_condition, model.cc:2422).
+
+        Fault tolerance (ISSUE 4, docs/fault_tolerance.md): when the config
+        asks for it (``--checkpoint-dir``/``--checkpoint-every``/
+        ``--resume``/``--max-bad-steps``) a ResilienceSession wraps the
+        loop — periodic async atomic checkpoints, SIGTERM/SIGINT preemption
+        flush, exact resume of the data-pipeline cursor, and the divergence
+        sentinel that skips non-finite steps and rolls back to the last
+        committed checkpoint. ``chaos`` takes a
+        ``resilience.ChaosPlan`` for deterministic fault injection (tests).
+        All of this is scoped to the SPMD path; the GPipe pipeline trainer
+        checkpoints only via explicit ``save_checkpoint`` calls."""
         import jax
 
         assert self.executor is not None, "call compile() first"
@@ -826,8 +847,23 @@ class FFModel:
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
         if self._pipeline_trainer is not None:
+            if chaos is not None:
+                raise ValueError(
+                    "chaos injection targets the SPMD fit loop; the GPipe "
+                    "pipeline trainer is not covered (see "
+                    "docs/fault_tolerance.md)")
             return self._fit_pipeline(xs, y, batch_size, epochs, shuffle)
-        step_fn = self.executor.make_train_step()
+        from .resilience.session import ResilienceSession
+
+        session = None
+        if ResilienceSession.wanted(self.config, chaos):
+            session = ResilienceSession(self, chaos=chaos)
+            session.install_signal_handlers()
+        guard = session.guard if session is not None else None
+        # guarded mode dispatches through `guard` (which owns its jitted
+        # variant); step_fn is the unguarded path's handle only
+        step_fn = (None if guard is not None
+                   else self.executor.make_train_step())
         from .data.dataloader import batch_iterator, prefetch_iterator
 
         in_shardings = [self.executor.batch_sharding(a.ndim) for a in xs]
@@ -836,8 +872,20 @@ class FFModel:
         self._perf = PerfMetrics()
         num_samples = xs[0].shape[0]
         steps_per_epoch = num_samples // batch_size
-        t0 = time.time()
+        epoch0, skip_batches = 0, 0
         step_count = 0
+        executed_steps = 0  # actual dispatches: THROUGHPUT must not count
+        # steps a preemption/resume skipped (step_count can also rewind on
+        # rollback; replayed steps were genuinely executed and do count)
+        self._preempted_at_step = None
+        if session is not None:
+            resumed = session.maybe_resume()
+            if resumed is not None:
+                step_count, epoch0, skip_batches = resumed
+                if steps_per_epoch and skip_batches >= steps_per_epoch:
+                    epoch0 += skip_batches // steps_per_epoch
+                    skip_batches %= steps_per_epoch
+        t0 = time.time()
         loss_val = None
         cache = (self.executor.init_cache()
                  if self.executor.cache_nodes else None)
@@ -857,50 +905,108 @@ class FFModel:
         if tracing:
             jax.profiler.start_trace(self.config.profiler_trace_dir)
         try:
-            epoch = 0
+            epoch = epoch0
+            preempted = False
             while epoch < epochs:
                 # shuffled epochs by default (the reference's loaders shuffle);
                 # the shuffled path stages batches through the native C++
-                # double-buffered BatchPipeline (data/dataloader.py)
+                # double-buffered BatchPipeline (data/dataloader.py).
+                # start_batch replays an interrupted epoch's tail: the same
+                # seed reproduces the shuffle, the cursor skips what the
+                # restored checkpoint already consumed
                 it = batch_iterator(xs + [y], batch_size, shuffle=shuffle,
-                                    seed=self.config.numpy_seed() + epoch)
+                                    seed=self.config.numpy_seed() + epoch,
+                                    start_batch=skip_batches)
+                batch_in_epoch = skip_batches
+                skip_batches = 0
                 epoch_metrics = []  # device-side; folded at epoch end (async)
                 recompiled = False
+                rolled_back = False
                 t_epoch = time.perf_counter()
                 for batch in prefetch_iterator(
                         it, in_shardings + [label_sharding]):
                     bx, by = batch[:-1], batch[-1]
+                    if session is not None and session.chaos is not None:
+                        bx = session.chaos.poison_batch(step_count, bx)
+                        session.chaos.maybe_preempt(step_count)
                     if telemetry is not None:
                         t_step = time.perf_counter()
-                    if cache is not None:
+                    step_ok = True
+                    if guard is not None:
+                        rng = self._next_rng()
+                        if cache is not None:
+                            outs, step_ok = guard(self.params, self.opt_state,
+                                                  bx, by, rng, cache)
+                            (self.params, self.opt_state, loss_val, m,
+                             fresh) = outs
+                        else:
+                            outs, step_ok = guard(self.params, self.opt_state,
+                                                  bx, by, rng)
+                            self.params, self.opt_state, loss_val, m = outs
+                            fresh = None
+                    elif cache is not None:
                         (self.params, self.opt_state, loss_val, m,
                          fresh) = step_fn(self.params, self.opt_state, bx, by,
                                           self._next_rng(), cache)
-                        self._score_caches(cache, fresh, step_count)
-                        cache.update(fresh)
                     else:
                         self.params, self.opt_state, loss_val, m = step_fn(
                             self.params, self.opt_state, bx, by,
                             self._next_rng())
-                    epoch_metrics.append(m)
+                        fresh = None
+                    if cache is not None and step_ok:
+                        self._score_caches(cache, fresh, step_count)
+                        cache.update(fresh)
                     step_count += 1
+                    batch_in_epoch += 1
+                    executed_steps += 1
+                    if step_ok:
+                        # a guarded bad step left params untouched; its
+                        # NaN metrics must not poison the epoch fold
+                        epoch_metrics.append(m)
                     loss_f = None
                     if telemetry is not None:
                         # observability is opt-in: the per-step sync it costs
                         # is what buys true step walls + the compile split
                         jax.block_until_ready(loss_val)
                         wall = time.perf_counter() - t_step
-                        loss_f = float(loss_val)
+                        loss_f = float(loss_val) if step_ok else None
                         telemetry.record_step(wall, loss_f)
                         tracer.complete("train_step", wall, step=step_count,
                                         loss=loss_f)
                         last_batch = (bx, by)
+                    if not step_ok:
+                        session.record_fault(step_count - 1)
+                        if guard.should_rollback:
+                            step_count, epoch, skip_batches = \
+                                session.rollback()
+                            cache = (self.executor.init_cache()
+                                     if self.executor.cache_nodes else None)
+                            epoch_metrics = []  # poisoned partials discarded
+                            rolled_back = True
+                            break
+                    if session is not None:
+                        session.on_step(step_count, epoch, batch_in_epoch,
+                                        steps_per_epoch)
+                        if session.preempted:
+                            # preemption grace window: flush a final
+                            # committed checkpoint, then stop cleanly
+                            self._preempted_at_step = step_count
+                            session.note_preemption(step_count)
+                            session.final_checkpoint(step_count, epoch,
+                                                     batch_in_epoch,
+                                                     steps_per_epoch)
+                            preempted = True
+                            break
                     if self._recompile_state is not None and \
                             self.recompile_on_condition(self._recompile_state):
                         # executor rebuilt: refresh the jitted step and cache,
                         # then RE-RUN this epoch on the new shardings (the break
                         # abandons the rest of its batches)
-                        step_fn = self.executor.make_train_step()
+                        if guard is not None:
+                            guard.executor = self.executor
+                            guard.rebuild()
+                        else:
+                            step_fn = self.executor.make_train_step()
                         cache = (self.executor.init_cache()
                                  if self.executor.cache_nodes else None)
                         recompiled = True
@@ -918,6 +1024,10 @@ class FFModel:
                 if epoch_metrics:
                     for m in jax.device_get(epoch_metrics):
                         self._perf.update(m)
+                if rolled_back:
+                    continue  # re-enter at the restored epoch/batch cursor
+                if preempted:
+                    break
                 if recompiled:
                     in_shardings = [self.executor.batch_sharding(a.ndim)
                                     for a in xs]
@@ -937,9 +1047,11 @@ class FFModel:
         finally:
             if tracing:
                 jax.profiler.stop_trace()
+            if session is not None:
+                session.close(telemetry)
         elapsed = time.time() - t0
         self._last_fit_time = elapsed
-        self._last_fit_samples = steps_per_epoch * batch_size * epochs
+        self._last_fit_samples = executed_steps * batch_size
         if elapsed > 0:
             throughput = self._last_fit_samples / elapsed
             if tracer.enabled:

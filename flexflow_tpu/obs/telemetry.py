@@ -82,6 +82,16 @@ class StepTelemetry:
         self.peak_flops: Optional[float] = None
         self.device_memory: Optional[Dict[str, int]] = None
         self.total_wall_s: float = 0.0
+        # resilience counters (ISSUE 4): filled by the fit loop's
+        # ResilienceSession at close — fault events (non-finite steps,
+        # preemption signals), recovery events (resume/rollback/flush),
+        # steps the sentinel skipped, checkpoints committed, and the step
+        # the run last resumed/rolled back to
+        self.fault_events: int = 0
+        self.recovery_events: int = 0
+        self.skipped_steps: int = 0
+        self.checkpoints_saved: int = 0
+        self.last_resume_step: Optional[int] = None
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -158,6 +168,18 @@ class StepTelemetry:
             out["device_memory"] = self.device_memory
         if self.metric_history:
             out["metric_history"] = self.metric_history
+        if (self.fault_events or self.recovery_events or self.skipped_steps
+                or self.checkpoints_saved
+                or self.last_resume_step is not None):
+            res: Dict[str, Any] = {
+                "fault_events": self.fault_events,
+                "recovery_events": self.recovery_events,
+                "skipped_steps": self.skipped_steps,
+                "checkpoints_saved": self.checkpoints_saved,
+            }
+            if self.last_resume_step is not None:
+                res["last_resume_step"] = self.last_resume_step
+            out["resilience"] = res
         return out
 
     def write(self, path: str) -> str:

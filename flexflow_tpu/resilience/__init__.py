@@ -1,0 +1,25 @@
+"""flexflow_tpu.resilience: fault-tolerant training subsystem (ISSUE 4).
+
+The reference FlexFlow inherits resilience from Legion's task runtime; our
+JAX port makes it a first-class subsystem instead — a preemption, a NaN'd
+loss, or a lost host must cost at most the work since the last committed
+checkpoint, never the run:
+
+* preemption-safe checkpointing: ``execution/checkpoint.py`` (atomic
+  commit, background async save with backpressure, checksums, retention,
+  exact data-pipeline resume) driven from ``Model.fit`` via
+  ``--checkpoint-dir`` / ``--checkpoint-every`` / ``--resume``;
+* divergence sentinels: ``sentinel.GuardedTrainStep`` (on-device NaN/Inf
+  check, one scalar transfer, skip + rollback via ``--max-bad-steps``);
+* elastic restart: ``elastic.elastic_restore`` (re-run the Unity search on
+  a degraded mesh, host-staged resharding of the restored pytree);
+* deterministic fault injection for testing all of it on CPU:
+  ``chaos.ChaosPlan`` / ``chaos.corrupt_checkpoint``.
+
+``session.ResilienceSession`` orchestrates these for one ``fit()``. See
+``docs/fault_tolerance.md``.
+"""
+from .chaos import ChaosPlan, corrupt_checkpoint  # noqa: F401
+from .elastic import elastic_restore  # noqa: F401
+from .sentinel import GuardedTrainStep  # noqa: F401
+from .session import ResilienceSession  # noqa: F401

@@ -1,0 +1,119 @@
+"""Deterministic fault injection: the resilience paths must be testable.
+
+Real failures (a TPU preemption SIGTERM, a NaN'd loss, bit rot in a
+checkpoint) are rare and non-deterministic; this harness scripts them so
+every recovery path runs on CPU in the fast test tier:
+
+* ``ChaosPlan(nan_at_steps={K})`` — poison the batch of step K with NaN
+  (the sentinel sees a genuinely non-finite loss/grad, exactly as a real
+  divergence would produce one).
+* ``ChaosPlan(preempt_at_step=M)`` — deliver a real ``SIGTERM`` to the
+  process right before step M dispatches, driving the same signal handler
+  a preemptible TPU pool would (``Model.fit`` installs it; the step
+  finishes, a final checkpoint is flushed, fit returns).
+* ``corrupt_checkpoint(path)`` — truncate / bit-flip / un-commit a written
+  checkpoint, for exercising the commit-marker and checksum defenses.
+
+Pass a plan to ``Model.fit(..., chaos=plan)``. Injection is once-per-step
+by default so a run that rolls back and re-executes step K replays it
+*clean* — the transient-fault model under which recovery must reconverge
+to the uninterrupted trajectory.
+"""
+from __future__ import annotations
+
+import os
+import signal
+from typing import Iterable, List, Optional
+
+from ..execution.checkpoint import COMMIT_MARKER, read_meta
+
+
+class ChaosPlan:
+    """Scripted fault schedule for one training run.
+
+    Steps are global 0-based step indices (the value ``step_count`` holds
+    as the step is about to dispatch). With ``once=True`` (default) each
+    scripted fault fires a single time even if the step is re-executed
+    after a rollback — the transient-fault model.
+    """
+
+    def __init__(self, nan_at_steps: Iterable[int] = (),
+                 preempt_at_step: Optional[int] = None,
+                 preempt_signal: int = signal.SIGTERM,
+                 once: bool = True):
+        self.nan_at_steps = {int(s) for s in nan_at_steps}
+        self.preempt_at_step = (None if preempt_at_step is None
+                                else int(preempt_at_step))
+        self.preempt_signal = preempt_signal
+        self.once = once
+        self.injected_nan_steps: List[int] = []
+        self.preempted_at: Optional[int] = None
+        self._nan_done: set = set()
+
+    # -- hooks called by Model.fit ------------------------------------------
+    def poison_batch(self, step: int, bx):
+        """Replace the first floating-point input of step ``step`` with NaN
+        (dtype-preserving, so the jitted step does not retrace)."""
+        if step not in self.nan_at_steps or \
+                (self.once and step in self._nan_done):
+            return bx
+        import jax.numpy as jnp
+
+        bx = list(bx)
+        for i, a in enumerate(bx):
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                bx[i] = a * jnp.asarray(float("nan"), dtype=a.dtype)
+                self._nan_done.add(step)
+                self.injected_nan_steps.append(step)
+                return bx
+        raise ValueError(
+            "ChaosPlan.nan_at_steps needs a floating-point model input to "
+            f"poison; step {step}'s batch has dtypes "
+            f"{[str(a.dtype) for a in bx]}")
+
+    def maybe_preempt(self, step: int) -> None:
+        """Deliver the scripted preemption signal before step ``step``
+        dispatches. Goes through ``os.kill`` so the REAL installed signal
+        handler runs — the fit loop then finishes the in-flight step,
+        flushes a final checkpoint and returns, exactly the TPU
+        grace-window protocol."""
+        if self.preempt_at_step is None or self.preempted_at is not None \
+                or step != self.preempt_at_step:
+            return
+        self.preempted_at = step
+        os.kill(os.getpid(), self.preempt_signal)
+
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> str:
+    """Deterministically damage a committed checkpoint; returns a
+    description of what was done.
+
+    * ``truncate`` — cut the largest checksummed payload file in half
+      (a killed copy / torn write).
+    * ``flip``     — flip one byte in the middle of that file (bit rot).
+    * ``uncommit`` — delete the commit marker (a writer that died between
+      staging and commit; ``latest_checkpoint`` must skip the dir).
+    """
+    path = os.path.abspath(path)
+    if mode == "uncommit":
+        os.remove(os.path.join(path, COMMIT_MARKER))
+        return f"removed {COMMIT_MARKER} from {path}"
+    sums = read_meta(path).get("checksums", {})
+    if not sums:
+        raise ValueError(f"{path}: no checksummed payload files")
+    # deterministic victim: the largest file, name as tie-break
+    rel = max(sorted(sums), key=lambda r: (sums[r][1], r))
+    fp = os.path.join(path, rel)
+    size = os.path.getsize(fp)
+    if mode == "truncate":
+        with open(fp, "r+b") as f:
+            f.truncate(max(size // 2, 0))
+        return f"truncated {rel} from {size} to {max(size // 2, 0)} bytes"
+    if mode == "flip":
+        with open(fp, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        return f"flipped byte {size // 2} of {rel}"
+    raise ValueError(f"unknown corruption mode {mode!r}")

@@ -1,0 +1,72 @@
+"""Elastic restart: resume a checkpoint on a degraded (or grown) mesh.
+
+A lost host or a shrunk TPU slice changes the device count; the checkpoint
+on disk was sharded for the old topology and its strategy may not even be
+expressible on the survivors. The reference has no answer to this (Legion
+restarts the whole job); related elastic-training work (Varuna, EuroSys'21)
+shows the right shape: re-plan for the surviving machine, reshard, continue.
+
+``elastic_restore`` does exactly that: when the target device count differs
+from the checkpoint's recorded topology it re-runs the Unity strategy
+search on the surviving devices (``FFModel.compile`` with the device-count
+override; pass ``sim=`` to reuse a warm delta-cost Simulator's memoized
+cost tables — the PR-2 caches make the re-search a fraction of a cold
+one), rebuilds mesh + executor for the winning strategy, and restores the
+checkpoint *host-staged*: every leaf is read to host and ``device_put``
+onto its new owner shards. Training then continues at the restored step.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..execution.checkpoint import (read_meta, restore_checkpoint,
+                                    restore_train_cursor)
+
+
+def elastic_restore(ffmodel, path: str, n_dev: Optional[int] = None,
+                    sim=None, verify: bool = True) -> int:
+    """Restore ``path`` onto the current (possibly degraded) topology.
+
+    ``n_dev`` defaults to the live ``jax.devices()`` count; pass it
+    explicitly to target a sub-mesh (tests use this to simulate a halved
+    slice on the virtual CPU mesh). ``sim`` is an optional warm
+    ``search.simulator.Simulator`` whose memoized cost tables the
+    re-search reuses. Returns the checkpoint's step; the model's
+    ``_rng_counter`` is restored from ``train_state.json`` when present so
+    a following ``fit(resume=...)``-style continuation is exact.
+    """
+    import jax
+    import numpy as np
+
+    meta = read_meta(path)
+    n_dev = int(n_dev if n_dev is not None else len(jax.devices()))
+    saved_ndev = int(meta.get("n_devices")
+                     or np.prod(meta.get("mesh_shape", [1])))
+    cur_shape = (list(ffmodel.strategy.mesh_shape)
+                 if ffmodel.strategy is not None else None)
+    if n_dev == saved_ndev and cur_shape == list(meta.get("mesh_shape", [])):
+        step = restore_checkpoint(ffmodel, path, verify=verify)
+        restore_train_cursor(ffmodel, path)
+        return step
+
+    # topology changed: re-plan on the surviving devices, then reshard
+    tracer = ffmodel._obs_tracer()
+    t0 = time.perf_counter()
+    ffmodel._search_sim = sim
+    ffmodel._elastic_n_dev = n_dev
+    try:
+        ffmodel.compile(
+            optimizer=ffmodel.optimizer, loss_type=ffmodel.loss_type,
+            metrics=(ffmodel.metrics_obj.measures
+                     if ffmodel.metrics_obj else None))
+    finally:
+        ffmodel._search_sim = None
+        ffmodel._elastic_n_dev = None
+    step = restore_checkpoint(ffmodel, path, verify=verify)
+    restore_train_cursor(ffmodel, path)
+    tracer.complete(
+        "recovery", time.perf_counter() - t0, kind="elastic_restart",
+        step=step, saved_devices=saved_ndev, new_devices=n_dev,
+        new_mesh=list(ffmodel.strategy.mesh_shape))
+    return step

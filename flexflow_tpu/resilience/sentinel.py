@@ -1,0 +1,75 @@
+"""Divergence sentinels: NaN/Inf-guarded training steps.
+
+A poisoned step (NaN loss or gradient, from a bad batch, an overflowed
+bf16 path, or flaky hardware) must not be allowed to write NaN into the
+weights — once it does, every later step is garbage and the run is lost.
+The guarded step (``Executor.make_train_step(guard=True)``) checks
+``isfinite(loss) & isfinite(|grad|²)`` *on device* and applies the
+optimizer update under ``lax.cond``: a bad step returns params/opt_state
+unchanged. The only extra host traffic is ONE boolean scalar per step,
+read here.
+
+``GuardedTrainStep`` is the host-side wrapper: it runs the guarded step,
+pays the single scalar transfer, tracks consecutive failures, and tells the
+fit loop when the ``--max-bad-steps`` budget is exhausted and a rollback to
+the last committed checkpoint (with the reduced-LR escape hatch,
+``resilience/session.py``) is due.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+
+class GuardedTrainStep:
+    """Host-side wrapper around the executor's guarded jitted step.
+
+    Call shape matches the plain step (cache-extended models included); the
+    return adds nothing — the verdict of the on-device finite check is read
+    via :meth:`last_ok` bookkeeping inside ``__call__``:
+
+        outs, ok = guard(params, opt_state, xs, labels, rng[, cache])
+
+    ``outs`` is exactly what the unguarded step would return. ``ok`` is the
+    host bool of the device-side check (the one scalar transfer per step).
+    """
+
+    def __init__(self, executor, max_bad_steps: int = 3):
+        self.executor = executor
+        self.max_bad_steps = max(int(max_bad_steps), 1)
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self._fn = None
+
+    @property
+    def fn(self):
+        if self._fn is None:
+            self._fn = self.executor.make_train_step(guard=True)
+        return self._fn
+
+    def rebuild(self) -> None:
+        """Drop the cached jitted step (after an LR change the update rule
+        baked into the jit is stale; the executor cache must be invalidated
+        by the caller first)."""
+        self._fn = None
+
+    def reset(self) -> None:
+        self.consecutive_bad = 0
+
+    def __call__(self, params, opt_state, xs, labels, rng,
+                 cache: Optional[Any] = None) -> Tuple[tuple, bool]:
+        if cache is not None:
+            *outs, ok_dev = self.fn(params, opt_state, xs, labels, rng,
+                                    cache)
+        else:
+            *outs, ok_dev = self.fn(params, opt_state, xs, labels, rng)
+        ok = bool(ok_dev)  # THE one device->host scalar transfer
+        if ok:
+            self.consecutive_bad = 0
+        else:
+            self.consecutive_bad += 1
+            self.total_bad += 1
+        return tuple(outs), ok
+
+    @property
+    def should_rollback(self) -> bool:
+        return self.consecutive_bad >= self.max_bad_steps

@@ -1,0 +1,256 @@
+"""ResilienceSession: the fault-tolerance orchestrator for one fit().
+
+One object owns every resilience concern of a training run so the fit loop
+stays readable: the async ``CheckpointManager`` (``--checkpoint-dir`` /
+``--checkpoint-every`` / ``--keep-checkpoints``), the SIGTERM/SIGINT
+preemption handlers (flag-setting only — the loop flushes a final
+checkpoint at the next step boundary, inside the TPU grace window), exact
+resume (``--resume auto|<path>``: params, opt state, epoch, batch cursor,
+rng counter), the divergence sentinel (``--max-bad-steps`` consecutive
+non-finite steps trigger an automatic rollback to the last committed
+checkpoint), and the scripted chaos hooks.
+
+Rollback semantics: the first rollback replays from the last good
+checkpoint unchanged — under the transient-fault model (a bad batch, a
+one-off hardware glitch) the replay is clean and the run reconverges to
+the uninterrupted trajectory. If divergence *persists* (a second rollback
+fires), the reduced-LR escape hatch multiplies the learning rate by
+``rollback_lr_factor`` before each further replay; after ``max_rollbacks``
+the run aborts rather than loop forever. Every event lands in the obs
+layer: ``fault`` instant events, ``recovery`` spans, and counters merged
+into ``StepTelemetry``.
+"""
+from __future__ import annotations
+
+import signal
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..execution.checkpoint import (CheckpointCorruptError,
+                                    CheckpointManager, latest_checkpoint,
+                                    list_checkpoints, restore_checkpoint,
+                                    restore_train_cursor)
+from .sentinel import GuardedTrainStep
+
+
+class ResilienceSession:
+    def __init__(self, ffmodel, chaos=None):
+        cfg = ffmodel.config
+        self.model = ffmodel
+        self.chaos = chaos
+        self.tracer = ffmodel._obs_tracer()
+        self.checkpoint_every = max(int(
+            getattr(cfg, "checkpoint_every", 0) or 0), 0)
+        self.manager: Optional[CheckpointManager] = None
+        if getattr(cfg, "checkpoint_dir", ""):
+            self.manager = CheckpointManager(
+                ffmodel, cfg.checkpoint_dir,
+                keep=getattr(cfg, "keep_checkpoints", 3))
+        self.guard: Optional[GuardedTrainStep] = None
+        if int(getattr(cfg, "max_bad_steps", 0) or 0) > 0:
+            self.guard = GuardedTrainStep(ffmodel.executor,
+                                          cfg.max_bad_steps)
+        self.rollback_lr_factor = float(
+            getattr(cfg, "rollback_lr_factor", 0.5) or 0.5)
+        self.max_rollbacks = max(int(
+            getattr(cfg, "max_rollbacks", 3) or 3), 1)
+        self.rollbacks = 0
+        # telemetry counters (merged into StepTelemetry at close)
+        self.fault_events = 0
+        self.recovery_events = 0
+        self.skipped_steps = 0
+        self.last_resume_step: Optional[int] = None
+        self.preempted = False
+        self.preempt_signum: Optional[int] = None
+        self._old_handlers: Dict[int, Any] = {}
+
+    @staticmethod
+    def wanted(config, chaos) -> bool:
+        """Any resilience feature requested? (The fit loop stays untouched
+        — zero per-step overhead — when this is False.)"""
+        return bool(getattr(config, "checkpoint_dir", "")
+                    or int(getattr(config, "max_bad_steps", 0) or 0) > 0
+                    or (getattr(config, "resume", "") or "").strip()
+                    or chaos is not None)
+
+    # ------------------------------------------------------------ signals --
+    def _on_signal(self, signum, frame) -> None:
+        # flags ONLY: the handler runs on the main thread at an arbitrary
+        # bytecode boundary — touching the tracer here could deadlock on
+        # its non-reentrant lock if the signal lands inside an in-progress
+        # emit. The fault event is deferred to the loop's next step
+        # boundary (note_preemption)
+        self.preempted = True
+        self.preempt_signum = signum
+
+    def note_preemption(self, step: int) -> None:
+        """Record the preemption the handler flagged — called from the fit
+        loop (safe context), right before the final flush."""
+        self.fault_events += 1
+        self.tracer.event("fault", kind="preemption_signal",
+                          signum=self.preempt_signum, step=step)
+
+    def install_signal_handlers(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:
+                pass  # not the main thread: preemption flagging unavailable
+
+    def restore_signal_handlers(self) -> None:
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except ValueError:
+                pass
+        self._old_handlers.clear()
+
+    # ------------------------------------------------------------- resume --
+    def maybe_resume(self) -> Optional[Tuple[int, int, int]]:
+        """Honor ``--resume``; returns (step, epoch, batch_in_epoch) after
+        restoring model state, or None for a fresh start. ``auto`` with no
+        committed checkpoint is a fresh start; an explicit path that is
+        missing or uncommitted raises."""
+        mode = (getattr(self.model.config, "resume", "") or "").strip()
+        if not mode:
+            return None
+        if mode == "auto":
+            d = getattr(self.model.config, "checkpoint_dir", "")
+            path = latest_checkpoint(d, verify=True) if d else None
+            if path is None:
+                return None
+        else:
+            path = mode
+        t0 = time.perf_counter()
+        step = restore_checkpoint(self.model, path)
+        ts = restore_train_cursor(self.model, path)
+        self.last_resume_step = step
+        self.recovery_events += 1
+        self.tracer.complete("recovery", time.perf_counter() - t0,
+                             kind="resume", path=path, step=step)
+        return step, int(ts.get("epoch", 0)), int(ts.get("batch_in_epoch", 0))
+
+    # -------------------------------------------------------- checkpointing --
+    def _train_state(self, step: int, epoch: int, batch_in_epoch: int,
+                     steps_per_epoch: int) -> Dict[str, Any]:
+        if steps_per_epoch and batch_in_epoch >= steps_per_epoch:
+            epoch, batch_in_epoch = epoch + 1, 0  # boundary-normalized
+        return {"step": int(step), "epoch": int(epoch),
+                "batch_in_epoch": int(batch_in_epoch),
+                "rng_counter": int(self.model._rng_counter)}
+
+    def on_step(self, step: int, epoch: int, batch_in_epoch: int,
+                steps_per_epoch: int) -> None:
+        """Periodic async checkpoint trigger (call after the step's update
+        landed in ``model.params``)."""
+        if self.manager is None or self.checkpoint_every <= 0:
+            return
+        if step % self.checkpoint_every == 0:
+            self.manager.save_async(
+                step, self._train_state(step, epoch, batch_in_epoch,
+                                        steps_per_epoch))
+
+    def final_checkpoint(self, step: int, epoch: int, batch_in_epoch: int,
+                         steps_per_epoch: int) -> Optional[str]:
+        """Preemption flush: drain pending saves, then commit the current
+        state synchronously — the last thing that must happen inside the
+        grace window."""
+        if self.manager is None:
+            return None
+        t0 = time.perf_counter()
+        path = self.manager.save_sync(
+            step, self._train_state(step, epoch, batch_in_epoch,
+                                    steps_per_epoch))
+        self.tracer.complete("recovery", time.perf_counter() - t0,
+                             kind="preemption_flush", step=step,
+                             path=path or "")
+        return path
+
+    # ------------------------------------------------------------ sentinel --
+    def record_fault(self, step: int, kind: str = "nonfinite_step") -> None:
+        self.fault_events += 1
+        self.skipped_steps += 1
+        self.tracer.event("fault", kind=kind, step=step)
+
+    def rollback(self) -> Tuple[int, int, int]:
+        """Restore the last committed checkpoint after the sentinel's
+        bad-step budget is exhausted. Returns (step, epoch,
+        batch_in_epoch) to re-enter the loop at. First rollback replays
+        as-is; repeated rollbacks engage the reduced-LR escape hatch."""
+        if self.manager is None:
+            raise RuntimeError(
+                "--max-bad-steps hit with no --checkpoint-dir: divergence "
+                "sentinel has no committed checkpoint to roll back to "
+                f"(loss/grads non-finite for {self.guard.consecutive_bad} "
+                "consecutive steps)")
+        self.manager.flush()
+        candidates = [p for _s, p in
+                      reversed(list_checkpoints(self.manager.directory))]
+        if not candidates:
+            raise RuntimeError(
+                "divergence sentinel: no committed checkpoint exists yet "
+                "(lower --checkpoint-every or raise --max-bad-steps)")
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise RuntimeError(
+                f"divergence persists after {self.max_rollbacks} rollbacks "
+                "(reduced-LR escape hatch included) — aborting the run")
+        t0 = time.perf_counter()
+        step = path = None
+        for cand in candidates:
+            # a bit-rotted newest checkpoint must not kill the run while
+            # older checksum-clean ones exist — fall back past it
+            try:
+                step = restore_checkpoint(self.model, cand)
+                path = cand
+                break
+            except CheckpointCorruptError:
+                self.fault_events += 1
+                self.tracer.event("fault", kind="corrupt_checkpoint",
+                                  path=cand)
+        if step is None:
+            raise RuntimeError(
+                "divergence sentinel: every committed checkpoint in "
+                f"{self.manager.directory} failed checksum verification")
+        ts = restore_train_cursor(self.model, path)
+        new_lr = None
+        if self.rollbacks > 1:
+            # persistent divergence: shrink the LR before replaying
+            opt = self.model.optimizer
+            cur = getattr(opt, "lr", None)
+            if cur is None:
+                cur = getattr(opt, "alpha", 0.0)
+            new_lr = float(cur) * self.rollback_lr_factor
+            opt.set_learning_rate(new_lr)
+            self.model.executor.invalidate_jit_cache()
+            if self.guard is not None:
+                self.guard.rebuild()
+        if self.guard is not None:
+            self.guard.reset()
+        self.recovery_events += 1
+        self.last_resume_step = step
+        self.tracer.complete(
+            "recovery", time.perf_counter() - t0, kind="rollback",
+            step=step, path=path, rollbacks=self.rollbacks,
+            **({"reduced_lr": new_lr} if new_lr is not None else {}))
+        return step, int(ts.get("epoch", 0)), int(ts.get("batch_in_epoch", 0))
+
+    # --------------------------------------------------------------- close --
+    def merge_telemetry(self, telemetry) -> None:
+        if telemetry is None:
+            return
+        telemetry.fault_events += self.fault_events
+        telemetry.recovery_events += self.recovery_events
+        telemetry.skipped_steps += self.skipped_steps
+        if self.manager is not None:
+            telemetry.checkpoints_saved += self.manager.saved
+        if self.last_resume_step is not None:
+            telemetry.last_resume_step = self.last_resume_step
+
+    def close(self, telemetry=None) -> None:
+        try:
+            if self.manager is not None:
+                self.manager.close()
+        finally:
+            self.restore_signal_handlers()
+            self.merge_telemetry(telemetry)

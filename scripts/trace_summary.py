@@ -114,6 +114,17 @@ def summarize_telemetry(data, top: int) -> None:
         peak = mem.get("peak_memory_in_bytes")
         if peak:
             print(f"XLA peak memory: {peak / 2 ** 20:.1f} MiB")
+    res = data.get("resilience")
+    if res:
+        # fault-tolerance headline (ISSUE 4): how eventful the run was and
+        # where it last picked itself back up
+        line = (f"faults: {res.get('fault_events', 0)} "
+                f"({res.get('skipped_steps', 0)} steps skipped)   "
+                f"recoveries: {res.get('recovery_events', 0)}   "
+                f"checkpoints: {res.get('checkpoints_saved', 0)}")
+        if res.get("last_resume_step") is not None:
+            line += f"   last resume at step {res['last_resume_step']}"
+        print(line)
     losses = data.get("loss_history", [])
     if losses:
         show = losses[:top]
