@@ -576,6 +576,13 @@ def memory_pressure_search_leg() -> dict:
         if getattr(res, "cache_stats", None):
             out["search_cost_cache_hit_rate"] = \
                 res.cache_stats.get("cost_cache_hit_rate")
+        # strategy-safety (ISSUE 5): depth of the ranked fallback chain the
+        # search hands the compile-time cascade, and how many runners-up
+        # are feasible under the memory budget
+        ranked = getattr(res, "ranked", []) or []
+        out["search_ranked_candidates"] = len(ranked)
+        out["search_ranked_feasible"] = sum(
+            1 for c in ranked if c.feasible)
         dp8 = {n.guid: OpSharding(dp=8) for n in pcg.compute_nodes()}
         _, mem_dp = sim.simulate(pcg, dp8, {})
         # time the DP baseline with the SAME event-driven engine the search

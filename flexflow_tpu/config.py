@@ -146,6 +146,23 @@ class FFConfig:
     rollback_lr_factor: float = 0.5
     max_rollbacks: int = 3
 
+    # strategy safety (flexflow_tpu/resilience/fallback.py + audit.py,
+    # docs/strategy_safety.md). "on" lets a failed strategy degrade through
+    # the search's ranked candidates -> dp+full-remat; "off" turns any
+    # verification failure into an immediate error. The verification pass
+    # only runs when it has something to check (audit / memory budget /
+    # chaos injection), so plain fits pay nothing.
+    strategy_fallback: str = "on"
+    # parallel-correctness audit: one probe batch under the live strategy
+    # vs a single-device reference; loss and grad-norm must agree within
+    # audit_tol relative error
+    audit_strategy: bool = False
+    audit_tol: float = 0.05
+    # compile-time OOM gate: XLA's compiled peak for the train step must
+    # fit this many MiB (0 = disabled; the -ll:fsize analog for the
+    # fallback cascade rather than the search)
+    memory_budget_mb: int = 0
+
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
     mesh_axis_names: Sequence[str] = ("data", "model")
@@ -186,9 +203,14 @@ class FFConfig:
 
     # -- reference-compatible flag parsing (model.cc:~3530-3700) ---------------
     def parse_args(self, argv: List[str]) -> None:
+        seen = set()  # our recognized flags present in THIS argv, for the
+        # cross-flag validation below (order-independent, and programmatic
+        # attribute assignment stays unvalidated-by-parse on purpose)
         i = 0
         while i < len(argv):
             a = argv[i]
+            if a.startswith("-"):
+                seen.add(a)
 
             def _next() -> str:
                 nonlocal i
@@ -275,6 +297,18 @@ class FFConfig:
                 self.max_bad_steps = int(_next())
             elif a == "--resume":
                 self.resume = _next()
+            elif a == "--strategy-fallback":
+                v = _next()
+                if v not in ("on", "off"):
+                    raise ValueError(
+                        f"--strategy-fallback expects on|off, got {v!r}")
+                self.strategy_fallback = v
+            elif a == "--audit-strategy":
+                self.audit_strategy = True
+            elif a == "--audit-tol":
+                self.audit_tol = float(_next())
+            elif a == "--memory-budget-mb":
+                self.memory_budget_mb = int(_next())
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -309,6 +343,42 @@ class FFConfig:
                 self.mesh_shape = tuple(int(x) for x in _next().split("x"))
             # unrecognized flags are ignored, matching the reference's behavior
             i += 1
+        self._validate_flag_combos(seen)
+
+    def _validate_flag_combos(self, seen: set) -> None:
+        """Fail fast at parse time on flag combinations that would
+        otherwise die mid-run with a far worse error (ISSUE 5 satellite).
+        Only flags present in the parsed argv are judged — programmatic
+        attribute assignment is validated later by
+        ``resilience.preflight.preflight_config`` at compile."""
+        if "--audit-tol" in seen and not self.audit_strategy:
+            raise ValueError(
+                "--audit-tol is only meaningful with --audit-strategy; add "
+                "--audit-strategy or drop --audit-tol")
+        if "--audit-tol" in seen and self.audit_tol <= 0:
+            raise ValueError(
+                f"--audit-tol must be > 0 (got {self.audit_tol}): it is "
+                "the relative loss/grad-norm error budget of the audit")
+        if "--keep-checkpoints" in seen and self.keep_checkpoints < 1:
+            raise ValueError(
+                f"--keep-checkpoints must keep at least 1 committed "
+                f"checkpoint (got {self.keep_checkpoints}); retention 0 "
+                "would delete the checkpoint --resume and rollback need")
+        if "--memory-budget-mb" in seen and self.memory_budget_mb < 0:
+            raise ValueError(
+                f"--memory-budget-mb must be >= 0 (got "
+                f"{self.memory_budget_mb}); 0 disables the check")
+        if "--resume" in seen:
+            if self.resume == "auto" and not self.checkpoint_dir:
+                raise ValueError(
+                    "--resume auto needs --checkpoint-dir to know where "
+                    "committed checkpoints live; pass --checkpoint-dir DIR "
+                    "or give --resume an explicit step_N checkpoint path")
+            if self.resume != "auto" and not os.path.isdir(self.resume):
+                raise ValueError(
+                    f"--resume {self.resume!r}: no such checkpoint "
+                    "directory; pass 'auto' (with --checkpoint-dir) or an "
+                    "existing step_N path")
 
     # -- derived properties -----------------------------------------------------
     def get_current_time(self) -> float:

@@ -446,10 +446,31 @@ def restore_checkpoint(ffmodel, path: str, mesh=None,
             if mesh is None:
                 raise CheckpointCorruptError(
                     f"{path}: sharded restore failed: {e}") from e
-    ffmodel.params = _host_staged_restore(
-        ckptr, os.path.join(path, "params"), ffmodel.params)
-    ffmodel.opt_state = _host_staged_restore(
-        ckptr, os.path.join(path, "opt_state"), ffmodel.opt_state)
+    try:
+        ffmodel.params = _host_staged_restore(
+            ckptr, os.path.join(path, "params"), ffmodel.params)
+        ffmodel.opt_state = _host_staged_restore(
+            ckptr, os.path.join(path, "opt_state"), ffmodel.opt_state)
+    except Exception as e:
+        # a topology-changing restore that still fails must name the two
+        # topologies and the way out, not surface a bare orbax/sharding
+        # exception (ISSUE 5 satellite)
+        import numpy as np
+
+        saved_ndev = int(meta.get("n_devices")
+                         or np.prod(meta.get("mesh_shape", [1]) or [1]))
+        live_ndev = len(jax.devices())
+        live_mesh = (list(ffmodel.strategy.mesh_shape)
+                     if ffmodel.strategy is not None else "?")
+        raise RuntimeError(
+            f"{path}: restore failed while resharding a checkpoint saved "
+            f"on {saved_ndev} device(s) (mesh "
+            f"{meta.get('mesh_shape', '?')}) onto the live {live_ndev}-"
+            f"device topology (mesh {live_mesh}): {type(e).__name__}: {e}. "
+            "For a changed topology use resilience.elastic_restore("
+            "ffmodel, path) — it re-runs the strategy search on the "
+            "surviving devices and reshards host-staged — or --resume on "
+            "the original topology.") from e
     return int(meta["step"])
 
 

@@ -44,6 +44,7 @@ class Executor:
         self._guarded_train_step = None
         self._eval_step = None
         self._forward_jit = None
+        self._probe_step = None
         # the RematPlan make_train_step resolved and applied (None until
         # built, and None when remat is off/ineligible) — telemetry reads it
         self.remat_plan = None
@@ -346,6 +347,7 @@ class Executor:
         self._guarded_train_step = None
         self._eval_step = None
         self._forward_jit = None
+        self._probe_step = None
 
     def make_train_step(self, guard: bool = False):
         """One fused jitted step: forward + loss + grad + metrics + update
@@ -450,6 +452,47 @@ class Executor:
         else:
             self._train_step = fn
         return fn
+
+    def make_probe_step(self):
+        """(params, xs, labels, rng[, cache]) -> (loss, grad_l2_norm):
+        forward + loss + grad with NO optimizer update and NO donation —
+        the parallel-correctness auditor's probe (resilience/audit.py).
+        The same loss recipe as the train step (mixed-precision cast, aux
+        losses, per-node guid-folded rng, so dropout masks replay
+        identically across strategies over the same graph); the two
+        returned scalars are the whole comparison surface."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._probe_step is not None:
+            return self._probe_step
+        mesh = self.mesh
+
+        def loss_fn(params, xs, labels, rng, cache):
+            params_c, xs = self._cast_for_compute(params, xs)
+            ctx = OpContext(training=True, rng=rng, mesh=mesh, aux_losses=[],
+                            cache_in=cache, cache_out={})
+            values = self.forward_outputs(params_c, self._bind_inputs(xs),
+                                          ctx)
+            logits = self._logits_f32(
+                values[self.final_guid][self.final_out_idx])
+            loss = loss_value(self.loss_type, logits, labels,
+                              self.repl_labels)
+            for aux in ctx.aux_losses:
+                loss = loss + aux
+            return loss
+
+        def probe(params, xs, labels, rng, cache=None):
+            loss, grads = jax.value_and_grad(loss_fn)(params, xs, labels,
+                                                      rng, cache)
+            leaves = jax.tree_util.tree_leaves(grads)
+            gsq = (sum(jnp.vdot(g, g).real.astype(jnp.float32)
+                       for g in leaves)
+                   if leaves else jnp.zeros((), jnp.float32))
+            return loss, jnp.sqrt(gsq)
+
+        self._probe_step = jax.jit(probe)
+        return self._probe_step
 
     def train_step_memory_analysis(self, params, opt_state, xs, labels):
         """XLA's compiled memory stats for the full training step

@@ -553,7 +553,13 @@ class FFModel:
         from .parallel.pcg import PCG
         from .parallel.strategy import Strategy, data_parallel_strategy
         from .ops.base import op_class_for
+        from .resilience.preflight import (preflight_config,
+                                           preflight_strategy)
 
+        # flag-combination sanity before any expensive work (ISSUE 5;
+        # parse-time single-flag checks live in FFConfig.parse_args, this
+        # covers programmatic attribute assignment too)
+        preflight_config(self.config)
         if optimizer is not None:
             self.optimizer = optimizer
         if self.optimizer is None:
@@ -563,6 +569,10 @@ class FFModel:
         # each compile decides afresh whether the export slot was consumed
         # by a --search-num-* target-machine strategy
         self._exported_search_target = False
+        # ranked fallback candidates from the previous search do not carry
+        # over: _run_search repopulates them when this compile searches
+        self._search_result = None
+        self._strategy_candidates = []
 
         # -- create_operators_from_layers (model.cc:2785) -----------------------
         pcg = self.create_pcg()
@@ -595,12 +605,19 @@ class FFModel:
         if strategy_fn is not None:
             strategy = strategy_fn(pcg)
         if strategy is not None:
-            # explicit strategy (hand-written or search output)
+            # explicit strategy (hand-written or search output) — the
+            # untrusted input: preflight BEFORE building the mesh so an
+            # indivisible plan dies with an actionable error, not a
+            # mesh-construction assert or an XLA sharding failure
+            preflight_strategy(pcg, strategy, n_dev=n_dev,
+                               batch_size=self.config.batch_size)
             self.strategy = strategy
             self.mesh = mesh_for_strategy(self.config, strategy)
         elif self.config.import_strategy_file:
             with open(self.config.import_strategy_file) as f:
                 self.strategy = Strategy.from_json(f.read(), pcg)
+            preflight_strategy(pcg, self.strategy, n_dev=n_dev,
+                               batch_size=self.config.batch_size)
             self.mesh = mesh_for_strategy(self.config, self.strategy)
         elif self.config.only_data_parallel or (
                 n_dev == 1 and not (self.config.search_num_nodes > 0
@@ -786,9 +803,20 @@ class FFModel:
         # its sink the same way via the output-shape contract).
         # _search_sim: an elastic restart hands the previous search's warm
         # Simulator in so the re-plan reuses its memoized delta-cost tables
-        return unity_search(pcg, self.config, n_dev,
-                            protected_guids=(self.final_guid,),
-                            sim=getattr(self, "_search_sim", None))
+        from .search.unity import SearchResult
+
+        res = unity_search(pcg, self.config, n_dev,
+                           protected_guids=(self.final_guid,),
+                           return_result=True,
+                           sim=getattr(self, "_search_sim", None))
+        if isinstance(res, SearchResult):
+            # ranked top-K fallback chain (ISSUE 5): kept on the model so
+            # the strategy-safety cascade can degrade through runners-up
+            # when the winner fails to compile / OOMs / fails the audit
+            self._search_result = res
+            self._strategy_candidates = list(res.ranked)
+            return res.strategy
+        return res  # search found nothing: plain data-parallel Strategy
 
     # ============================================================ training ==
     def _next_rng(self):
@@ -846,6 +874,11 @@ class FFModel:
         y = self._prep_label(y)
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
+        from .resilience.preflight import validate_batch
+
+        # fail on a mis-shaped/mis-typed batch HERE, naming the tensor and
+        # axis — not as a cryptic XLA error mid-epoch (ISSUE 5 satellite)
+        validate_batch(self, xs, y, phase="fit")
         if self._pipeline_trainer is not None:
             if chaos is not None:
                 raise ValueError(
@@ -853,6 +886,18 @@ class FFModel:
                     "pipeline trainer is not covered (see "
                     "docs/fault_tolerance.md)")
             return self._fit_pipeline(xs, y, batch_size, epochs, shuffle)
+        # strategy-safety cascade (ISSUE 5, docs/strategy_safety.md): when
+        # armed (--audit-strategy / --memory-budget-mb / strategy chaos),
+        # verify the plan BEFORE the loop — preflight, compile + one probe
+        # step, memory budget, parallel-correctness audit — degrading
+        # through the search's ranked candidates on failure. May swap
+        # self.executor/strategy, so it runs before anything binds them.
+        from .resilience.fallback import StrategyCascade
+
+        cascade = StrategyCascade.maybe_create(self, chaos)
+        self._last_cascade = cascade
+        if cascade is not None:
+            cascade.preverify(xs, y, batch_size)
         from .resilience.session import ResilienceSession
 
         session = None
@@ -895,6 +940,10 @@ class FFModel:
         tracer = self._obs_tracer()
         telemetry = self._make_telemetry(tracer, batch_size, "train")
         self._telemetry = telemetry
+        if cascade is not None:
+            # counters are final after preverify; the final strategy the
+            # cascade settled on lands in the telemetry record
+            cascade.merge_telemetry(telemetry)
         last_batch = None
         if self.config.profiling:
             self.profile_operators()
@@ -1188,6 +1237,9 @@ class FFModel:
         xs = self._as_input_list(x)
         y = self._prep_label(y)
         batch_size = batch_size or self.config.batch_size
+        from .resilience.preflight import validate_batch
+
+        validate_batch(self, xs, y, phase="eval")
         estep = self.executor.make_eval_step()
         from .data.dataloader import batch_iterator
 
@@ -1217,6 +1269,9 @@ class FFModel:
     def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
         xs = self._as_input_list(x)
         batch_size = batch_size or self.config.batch_size
+        from .resilience.preflight import validate_batch
+
+        validate_batch(self, xs, None, phase="predict")
         fwd = self.executor.make_forward()
         from .data.dataloader import batch_iterator
 

@@ -92,6 +92,14 @@ class StepTelemetry:
         self.skipped_steps: int = 0
         self.checkpoints_saved: int = 0
         self.last_resume_step: Optional[int] = None
+        # strategy-safety counters (ISSUE 5): filled by the fit loop's
+        # StrategyCascade — compile-time fallbacks taken, parallel-
+        # correctness audits run/failed, and the strategy the run actually
+        # trained under (which may not be the search winner)
+        self.strategy_fallbacks: int = 0
+        self.audit_runs: int = 0
+        self.audit_failures: int = 0
+        self.final_strategy: Optional[str] = None
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -180,6 +188,16 @@ class StepTelemetry:
             if self.last_resume_step is not None:
                 res["last_resume_step"] = self.last_resume_step
             out["resilience"] = res
+        if (self.strategy_fallbacks or self.audit_runs
+                or self.final_strategy is not None):
+            ss: Dict[str, Any] = {
+                "fallbacks": self.strategy_fallbacks,
+                "audit_runs": self.audit_runs,
+                "audit_failures": self.audit_failures,
+            }
+            if self.final_strategy is not None:
+                ss["final_strategy"] = self.final_strategy
+            out["strategy_safety"] = ss
         return out
 
     def write(self, path: str) -> str:

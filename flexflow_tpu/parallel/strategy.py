@@ -63,6 +63,19 @@ class Strategy:
     def for_node(self, guid: int) -> NodeStrategy:
         return self.node_strategies.setdefault(guid, NodeStrategy())
 
+    def describe(self) -> str:
+        """Compact human-readable plan id ("mesh=(4, 2) remat=selective"),
+        used by strategy-fallback telemetry/obs events and error diagnoses
+        (resilience/fallback.py, docs/strategy_safety.md)."""
+        bits = [f"mesh={tuple(self.mesh_shape)}"]
+        if self.pipeline:
+            bits.append(f"pipeline={tuple(self.pipeline)}")
+        if self.remat and self.remat != "none":
+            bits.append(f"remat={self.remat}")
+        if self.hybrid:
+            bits.append(f"dcn={tuple(self.hybrid[1])}")
+        return " ".join(bits)
+
     # -- serialization (reference: export_strategy_file) ------------------------
     def to_json(self, pcg: PCG) -> str:
         out = {

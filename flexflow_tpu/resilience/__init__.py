@@ -14,12 +14,22 @@ checkpoint, never the run:
 * elastic restart: ``elastic.elastic_restore`` (re-run the Unity search on
   a degraded mesh, host-staged resharding of the restored pytree);
 * deterministic fault injection for testing all of it on CPU:
-  ``chaos.ChaosPlan`` / ``chaos.corrupt_checkpoint``.
+  ``chaos.ChaosPlan`` / ``chaos.corrupt_checkpoint``;
+* strategy safety (ISSUE 5, docs/strategy_safety.md): ``preflight``
+  (static strategy/flag/batch validation), ``audit`` (parallel-correctness
+  probe vs a single-device reference), ``fallback.StrategyCascade`` (the
+  compile-time degrade-through-ranked-candidates cascade).
 
-``session.ResilienceSession`` orchestrates these for one ``fit()``. See
-``docs/fault_tolerance.md``.
+``session.ResilienceSession`` orchestrates the runtime concerns for one
+``fit()``; ``fallback.StrategyCascade`` the compile-time ones. See
+``docs/fault_tolerance.md`` and ``docs/strategy_safety.md``.
 """
+from .audit import AuditError, AuditReport, audit_strategy  # noqa: F401
 from .chaos import ChaosPlan, corrupt_checkpoint  # noqa: F401
 from .elastic import elastic_restore  # noqa: F401
+from .fallback import (MemoryBudgetError, StrategyCascade,  # noqa: F401
+                       StrategyCompileError, StrategySafetyError)
+from .preflight import (PreflightError, preflight_config,  # noqa: F401
+                        preflight_strategy, validate_batch)
 from .sentinel import GuardedTrainStep  # noqa: F401
 from .session import ResilienceSession  # noqa: F401

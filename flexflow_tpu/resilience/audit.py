@@ -1,0 +1,137 @@
+"""Parallel-correctness auditor: refuse plans that compute wrong gradients.
+
+The worst strategy failure is not a crash — it is a plan that compiles,
+runs, and silently trains on *wrong gradients* (a bad substitution rule, a
+resharding that drops or double-counts a partial sum). The search's cost
+model cannot see this; only execution can. The auditor (ISSUE 5,
+``--audit-strategy``) runs ONE probe batch twice over the same graph:
+
+* under the candidate strategy, exactly as the train step would execute it
+  (same mixed-precision cast, aux losses, guid-folded dropout rng), via
+  ``Executor.make_probe_step``;
+* under a single-device data-parallel *reference* executor — the plan with
+  no resharding to get wrong.
+
+and compares the loss and the global gradient L2 norm within
+``--audit-tol`` relative error. Two scalars are a deliberately small
+comparison surface: any dropped/doubled collective anywhere in the
+backward pass moves the global grad norm, while per-leaf comparison would
+cost a full host gather of both pytrees. A failed audit raises (or, under
+the fallback cascade, demotes the plan to the next ranked candidate).
+
+Chaos hook: ``ChaosPlan(wrong_reshard=True)`` scales the candidate's
+reported grad norm (default 2.0 — a double-counted gradient allreduce), so
+the reject path is CPU-testable without a genuinely miscompiled plan. See
+``docs/strategy_safety.md``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class AuditError(RuntimeError):
+    """The candidate strategy's probe diverged from the single-device
+    reference beyond ``--audit-tol`` — the plan is presumed miscompiled."""
+
+
+@dataclasses.dataclass
+class AuditReport:
+    passed: bool
+    loss_candidate: float
+    loss_reference: float
+    grad_norm_candidate: float
+    grad_norm_reference: float
+    loss_rel_err: float
+    grad_rel_err: float
+    tol: float
+    strategy: str = ""
+
+    def detail(self) -> str:
+        return (f"loss {self.loss_candidate:.6g} vs reference "
+                f"{self.loss_reference:.6g} (rel err "
+                f"{self.loss_rel_err:.3g}), grad norm "
+                f"{self.grad_norm_candidate:.6g} vs "
+                f"{self.grad_norm_reference:.6g} (rel err "
+                f"{self.grad_rel_err:.3g}), tol {self.tol:g}")
+
+
+def _reference_executor(ffmodel):
+    """A single-device data-parallel executor over the SAME compiled graph:
+    no tensor/sequence/expert sharding, so there is no resharding rule to
+    have gotten wrong — the numerical ground truth for the audit."""
+    import jax
+
+    from ..execution.executor import Executor
+    from ..parallel.mesh import build_mesh
+    from ..parallel.strategy import data_parallel_strategy
+
+    strat = data_parallel_strategy(ffmodel.pcg, 1)
+    mesh = build_mesh(None, mesh_shape=(1,), axis_names=("data",),
+                      devices=jax.devices()[:1])
+    ex = ffmodel.executor
+    return Executor(ffmodel.pcg, mesh, strat, ffmodel.loss_type,
+                    ffmodel.metrics_obj, ffmodel.optimizer, ffmodel.config,
+                    ffmodel.final_guid, ex.label_dtype, ex.repl_labels,
+                    final_out_idx=ex.final_out_idx)
+
+
+def audit_strategy(ffmodel, xs, y, tol: float = 0.05,
+                   chaos=None, ref_cache: Optional[dict] = None
+                   ) -> AuditReport:
+    """Run the probe batch under the model's live strategy and under the
+    single-device reference; returns an :class:`AuditReport` (never raises
+    on a mere mismatch — the caller decides between refuse and fall back).
+
+    ``xs``/``y`` are host arrays of one batch (labels may be raw; they are
+    passed through the model's label prep). ``tol`` is the relative-error
+    budget for BOTH scalars; non-finite values on either side fail.
+    ``ref_cache`` (a dict the caller owns) memoizes the reference scalars:
+    the reference is candidate-independent, so a fallback cascade auditing
+    several candidates over the same probe pays its compile once."""
+    import jax
+
+    xs = [np.asarray(a) for a in ffmodel._as_input_list(xs)]
+    y = ffmodel._prep_label(np.asarray(y))
+    ex = ffmodel.executor
+    rng = jax.random.PRNGKey(0)
+
+    probe = ex.make_probe_step()
+    in_sh = [ex.batch_sharding(a.ndim) for a in xs]
+    bx = [jax.device_put(a, s) for a, s in zip(xs, in_sh)]
+    by = jax.device_put(y, ex.batch_sharding(y.ndim))
+    cargs = (ffmodel.params, bx, by, rng)
+    if ex.cache_nodes:
+        cargs = cargs + (ex.init_cache(),)
+    loss_c, gn_c = (float(v) for v in jax.device_get(probe(*cargs)))
+    if chaos is not None:
+        gn_c *= float(chaos.consume_wrong_reshard())
+
+    if ref_cache is not None and "ref" in ref_cache:
+        loss_r, gn_r = ref_cache["ref"]
+    else:
+        ref = _reference_executor(ffmodel)
+        host_params = {ln: {wn: np.asarray(a) for wn, a in ws.items()}
+                       for ln, ws in ffmodel.params.items()}
+        rargs = (host_params, xs, y, rng)
+        if ref.cache_nodes:
+            rargs = rargs + (ref.init_cache(),)
+        loss_r, gn_r = (float(v) for v in
+                        jax.device_get(ref.make_probe_step()(*rargs)))
+        if ref_cache is not None:
+            ref_cache["ref"] = (loss_r, gn_r)
+
+    def rel(a: float, b: float) -> float:
+        return abs(a - b) / max(abs(b), 1e-8)
+
+    loss_err, grad_err = rel(loss_c, loss_r), rel(gn_c, gn_r)
+    finite = bool(np.all(np.isfinite([loss_c, gn_c, loss_r, gn_r])))
+    passed = finite and loss_err <= tol and grad_err <= tol
+    return AuditReport(
+        passed=passed, loss_candidate=loss_c, loss_reference=loss_r,
+        grad_norm_candidate=gn_c, grad_norm_reference=gn_r,
+        loss_rel_err=loss_err, grad_rel_err=grad_err, tol=tol,
+        strategy=(ffmodel.strategy.describe()
+                  if ffmodel.strategy is not None else "?"))

@@ -13,11 +13,21 @@ every recovery path runs on CPU in the fast test tier:
   finishes, a final checkpoint is flushed, fit returns).
 * ``corrupt_checkpoint(path)`` — truncate / bit-flip / un-commit a written
   checkpoint, for exercising the commit-marker and checksum defenses.
+* ``ChaosPlan(fail_compiles=N)`` — the strategy-safety cascade's
+  compile check (resilience/fallback.py) raises a scripted XLA-compile
+  failure for the first N candidates, driving the ranked-fallback path.
+* ``ChaosPlan(wrong_reshard=True)`` — the parallel-correctness auditor's
+  candidate probe reports a grad-norm scaled by ``wrong_reshard_factor``
+  (default 2.0 — the signature of a double-counted gradient allreduce
+  from a miscompiled resharding rule), so the audit-reject path runs on
+  CPU without a genuinely miscompiled plan.
 
 Pass a plan to ``Model.fit(..., chaos=plan)``. Injection is once-per-step
 by default so a run that rolls back and re-executes step K replays it
 *clean* — the transient-fault model under which recovery must reconverge
-to the uninterrupted trajectory.
+to the uninterrupted trajectory. The strategy-safety injections follow the
+same once model: the NEXT candidate compiles/audits clean, so the cascade
+lands on a working fallback.
 """
 from __future__ import annotations
 
@@ -40,7 +50,10 @@ class ChaosPlan:
     def __init__(self, nan_at_steps: Iterable[int] = (),
                  preempt_at_step: Optional[int] = None,
                  preempt_signal: int = signal.SIGTERM,
-                 once: bool = True):
+                 once: bool = True,
+                 fail_compiles: int = 0,
+                 wrong_reshard: bool = False,
+                 wrong_reshard_factor: float = 2.0):
         self.nan_at_steps = {int(s) for s in nan_at_steps}
         self.preempt_at_step = (None if preempt_at_step is None
                                 else int(preempt_at_step))
@@ -49,6 +62,12 @@ class ChaosPlan:
         self.injected_nan_steps: List[int] = []
         self.preempted_at: Optional[int] = None
         self._nan_done: set = set()
+        # strategy-safety injections (resilience/fallback.py, audit.py)
+        self.fail_compiles = int(fail_compiles)
+        self.compile_failures_injected = 0
+        self.wrong_reshard = bool(wrong_reshard)
+        self.wrong_reshard_factor = float(wrong_reshard_factor)
+        self.wrong_reshards_injected = 0
 
     # -- hooks called by Model.fit ------------------------------------------
     def poison_batch(self, step: int, bx):
@@ -70,6 +89,38 @@ class ChaosPlan:
             "ChaosPlan.nan_at_steps needs a floating-point model input to "
             f"poison; step {step}'s batch has dtypes "
             f"{[str(a.dtype) for a in bx]}")
+
+    # -- hooks called by the strategy-safety cascade / auditor --------------
+    def strategy_chaos_pending(self) -> bool:
+        """Any strategy-safety injection still pending? (What arms the
+        fallback cascade's pre-fit verification.)"""
+        return (self.compile_failures_injected < self.fail_compiles
+                or (self.wrong_reshard
+                    and (not self.once
+                         or self.wrong_reshards_injected == 0)))
+
+    def consume_compile_failure(self) -> bool:
+        """True while scripted compile failures remain: the cascade's
+        compile check treats it exactly like XLA rejecting the plan. Each
+        call consumes one injection, so candidate N+fail_compiles compiles
+        clean and the cascade lands on it."""
+        if self.compile_failures_injected < self.fail_compiles:
+            self.compile_failures_injected += 1
+            return True
+        return False
+
+    def consume_wrong_reshard(self) -> float:
+        """Grad-norm factor the auditor applies to the CANDIDATE probe —
+        != 1.0 while the injection is pending, simulating a plan whose
+        miscompiled resharding double-counts the gradient allreduce (loss
+        matches the reference, the grad norm is off by the factor). With
+        ``once=True`` it fires on a single audit, so the cascade's next
+        candidate audits clean."""
+        if self.wrong_reshard and (not self.once
+                                   or self.wrong_reshards_injected == 0):
+            self.wrong_reshards_injected += 1
+            return self.wrong_reshard_factor
+        return 1.0
 
     def maybe_preempt(self, step: int) -> None:
         """Deliver the scripted preemption signal before step ``step``

@@ -1,0 +1,322 @@
+"""Strategy fallback cascade: never trust one compilation.
+
+The Unity search hands the executor ONE winning strategy; before this
+module that plan was a single point of failure — XLA rejecting it, a
+compile-time OOM, or a miscompiled resharding cost the whole run (or,
+silently, its correctness). The cascade (ISSUE 5) makes the plan itself
+fault-tolerant, the way PR 4 made the step loop fault-tolerant. Verification
+runs ONCE before the fit loop (``StrategyCascade.preverify``); for the
+active strategy, in order:
+
+1. **preflight** — static divisibility audit (``preflight.py``), free;
+2. **compile check** — build the exact jitted step the loop will run and
+   execute ONE step on throwaway device-side copies: XLA compile errors
+   and first-step failures surface here (the jit cache is shared, so the
+   loop's real first step pays no second compile). A
+   ``ChaosPlan(fail_compiles=N)`` injection fails this stage on script;
+3. **memory budget** — ``--memory-budget-mb``: XLA's compiled peak
+   (``train_step_memory_analysis``) must fit, the ``-ll:fsize`` analog of
+   the reference's per-device memory validation (graph.cc:1984-2032);
+4. **audit** — ``--audit-strategy``: the parallel-correctness probe
+   (``audit.py``) against a single-device reference within ``--audit-tol``.
+
+On any failure the cascade degrades: next ranked search candidate
+(``SearchResult.ranked``, re-mapped by node name onto a fresh PCG) → the
+dp+full-remat last resort → abort with a diagnosis listing every rejected
+plan and why. Pre-fit weight edits survive each hop (params are re-seeded
+host-staged onto the new shardings). Every hop emits a
+``strategy_fallback`` obs event and lands in ``StepTelemetry``'s
+``strategy_safety`` block; ``--strategy-fallback off`` turns failures into
+immediate errors (audit-only refusal mode). See ``docs/strategy_safety.md``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .audit import AuditError
+from .preflight import PreflightError, preflight_strategy
+
+
+class StrategySafetyError(RuntimeError):
+    """The strategy-safety layer rejected the plan (and, with the cascade
+    on, every fallback after it)."""
+
+
+class StrategyCompileError(StrategySafetyError):
+    """The candidate failed the compile check (XLA rejection, first-step
+    failure, or a scripted chaos injection)."""
+
+
+class MemoryBudgetError(StrategySafetyError):
+    """XLA's compiled peak exceeds ``--memory-budget-mb``."""
+
+
+_FAILURE_KINDS = (PreflightError, AuditError, StrategySafetyError)
+
+
+class StrategyCascade:
+    """One fit()'s strategy-safety verification + fallback driver."""
+
+    def __init__(self, ffmodel, chaos=None):
+        cfg = ffmodel.config
+        self.model = ffmodel
+        self.chaos = chaos
+        self.tracer = ffmodel._obs_tracer()
+        self.fallback_on = (getattr(cfg, "strategy_fallback", "on")
+                            or "on") != "off"
+        self.audit_on = bool(getattr(cfg, "audit_strategy", False))
+        self.tol = float(getattr(cfg, "audit_tol", 0.05) or 0.05)
+        self.budget_bytes = int(
+            getattr(cfg, "memory_budget_mb", 0) or 0) * 2 ** 20
+        self.fallbacks = 0
+        self.audits = 0
+        self.audit_failures = 0
+        self.audit_reports: List = []
+        self._audit_ref_cache: dict = {}
+        self.failures: List[Tuple[str, str]] = []
+        self.final_desc = (ffmodel.strategy.describe()
+                           if ffmodel.strategy is not None else "?")
+        ranked = list(getattr(ffmodel, "_strategy_candidates", []) or [])
+        # rank 0 is the winner the model already compiled; runners-up must
+        # be SPMD (the cascade re-enters the SPMD fit loop — the GPipe
+        # trainer is out of its scope) and carry a name-re-mappable
+        # serialized strategy
+        self._pending = [c for c in ranked[1:]
+                         if c.strategy_json and not c.pipeline]
+        self._dp_tried = False
+
+    @classmethod
+    def maybe_create(cls, ffmodel, chaos=None) -> Optional["StrategyCascade"]:
+        """The cascade only arms when there is something to verify — the
+        audit flag, a memory budget, or pending strategy chaos. A plain fit
+        pays zero overhead (no probe step, no extra lowering).
+        ``--strategy-fallback off`` does NOT disarm verification — it only
+        turns failures into immediate errors (refusal mode)."""
+        cfg = ffmodel.config
+        audit = bool(getattr(cfg, "audit_strategy", False))
+        budget = int(getattr(cfg, "memory_budget_mb", 0) or 0) > 0
+        chaos_armed = chaos is not None and getattr(
+            chaos, "strategy_chaos_pending", lambda: False)()
+        if not (audit or budget or chaos_armed):
+            return None
+        return cls(ffmodel, chaos)
+
+    # ------------------------------------------------------------- verify --
+    def preverify(self, xs, y, batch_size: int) -> None:
+        """Run the cascade to a verified strategy (possibly after several
+        fallbacks) or raise a :class:`StrategySafetyError` diagnosis."""
+        model = self.model
+        # probe data is one fit batch; a dataset smaller than the batch
+        # yields NO training steps (drop_remainder), so the execution
+        # probes are skipped — but preflight still judges the REAL batch
+        # size the loop would use, not the clipped probe
+        n = min(int(batch_size), int(np.asarray(xs[0]).shape[0]))
+        probe_xs = [np.asarray(a[:n]) for a in xs]
+        probe_y = np.asarray(y[:n])
+        run_probes = n == int(batch_size)
+        while True:
+            desc = (model.strategy.describe()
+                    if model.strategy is not None else "?")
+            try:
+                self._verify_current(desc, probe_xs, probe_y, batch_size,
+                                     run_probes)
+            except _FAILURE_KINDS as e:
+                reason = f"{type(e).__name__}: {e}"
+                self.failures.append((desc, reason))
+                self.tracer.event("strategy_rejected", strategy=desc,
+                                  reason=reason[:300])
+                if not self.fallback_on:
+                    raise
+                self._fall_back(reason, cause=e)
+                continue
+            self.final_desc = (model.strategy.describe()
+                               if model.strategy is not None else "?")
+            if self.fallbacks:
+                self.tracer.event("strategy_fallback_final",
+                                  strategy=self.final_desc,
+                                  fallbacks=self.fallbacks)
+            return
+
+    def _fall_back(self, reason: str, cause: Exception) -> None:
+        """Advance to the next applicable candidate; a candidate that
+        fails to APPLY (its own preflight at compile, a bad remap) joins
+        the diagnosis and the cascade keeps degrading rather than dying
+        with a bare error."""
+        while True:
+            nxt = self._next_candidate()
+            if nxt is None:
+                lines = "\n".join(f"  {d}: {r}" for d, r in self.failures)
+                raise StrategySafetyError(
+                    "strategy-safety cascade exhausted — every candidate "
+                    "(ranked search results and the dp+full-remat last "
+                    "resort) was rejected:\n" + lines) from cause
+            try:
+                self._apply(nxt, reason=reason)
+                return
+            except Exception as e:
+                to_desc = (nxt if isinstance(nxt, str) else nxt.describe())
+                self.failures.append(
+                    (to_desc,
+                     f"fallback apply failed: {type(e).__name__}: {e}"))
+
+    def _verify_current(self, desc: str, probe_xs, probe_y,
+                        batch_size: int, run_probes: bool = True) -> None:
+        import jax
+
+        model = self.model
+        preflight_strategy(model.pcg, model.strategy,
+                           n_dev=len(jax.devices()), batch_size=batch_size)
+        if not run_probes:
+            return
+        self._compile_check(desc, probe_xs, probe_y)
+        if self.budget_bytes:
+            self._memory_check(desc, probe_xs, probe_y)
+        if self.audit_on:
+            self._audit_check(desc, probe_xs, probe_y)
+
+    def _compile_check(self, desc: str, probe_xs, probe_y) -> None:
+        """Compile the EXACT jitted step the loop will dispatch (guarded
+        when the sentinel is on) and execute one step on donation-safe
+        device copies — the result is discarded, the jit cache stays warm
+        for the loop's real first step."""
+        import jax
+
+        model = self.model
+        if self.chaos is not None and self.chaos.consume_compile_failure():
+            raise StrategyCompileError(
+                f"chaos: injected XLA compile failure for {desc}")
+        from ..execution.checkpoint import _device_snapshot
+
+        guard = int(getattr(model.config, "max_bad_steps", 0) or 0) > 0
+        try:
+            step = model.executor.make_train_step(guard=guard)
+            ex = model.executor
+            in_sh = [ex.batch_sharding(a.ndim) for a in probe_xs]
+            bx = [jax.device_put(a, s) for a, s in zip(probe_xs, in_sh)]
+            by = jax.device_put(probe_y, ex.batch_sharding(probe_y.ndim))
+            args = (_device_snapshot(model.params),
+                    _device_snapshot(model.opt_state), bx, by,
+                    jax.random.PRNGKey(0))
+            if ex.cache_nodes:
+                args = args + (ex.init_cache(),)
+            out = step(*args)
+            jax.block_until_ready(out[2])  # the loss: compile + one step ran
+        except _FAILURE_KINDS:
+            raise
+        except Exception as e:
+            raise StrategyCompileError(
+                f"{desc}: train-step compile / first-step probe failed: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _memory_check(self, desc: str, probe_xs, probe_y) -> None:
+        import warnings
+
+        from ..obs.telemetry import peak_memory_bytes
+
+        model = self.model
+        try:
+            ma = model.executor.train_step_memory_analysis(
+                model.params, model.opt_state, probe_xs, probe_y)
+        except Exception as e:
+            # a backend without compiled memory stats makes the gate moot,
+            # but NEVER silently: the user asked for a hard OOM gate
+            warnings.warn(
+                f"--memory-budget-mb check skipped for {desc}: compiled "
+                f"memory analysis unavailable ({type(e).__name__}: {e})")
+            return
+        peak = peak_memory_bytes(ma)
+        if peak is not None and peak > self.budget_bytes:
+            raise MemoryBudgetError(
+                f"{desc}: XLA compiled peak {peak / 2 ** 20:.1f} MiB "
+                f"exceeds --memory-budget-mb "
+                f"{self.budget_bytes // 2 ** 20} MiB")
+
+    def _audit_check(self, desc: str, probe_xs, probe_y) -> None:
+        from .audit import audit_strategy
+
+        self.audits += 1
+        # the single-device reference is candidate-independent (same graph,
+        # same host weights, same probe): computed once, reused across
+        # every candidate this cascade audits
+        report = audit_strategy(self.model, probe_xs, probe_y, tol=self.tol,
+                                chaos=self.chaos,
+                                ref_cache=self._audit_ref_cache)
+        self.audit_reports.append(report)
+        self.tracer.event("strategy_audit", strategy=desc,
+                          passed=bool(report.passed),
+                          loss_rel_err=round(report.loss_rel_err, 6),
+                          grad_rel_err=round(report.grad_rel_err, 6))
+        if not report.passed:
+            self.audit_failures += 1
+            raise AuditError(
+                f"{desc}: parallel-correctness audit failed — "
+                + report.detail())
+
+    # ----------------------------------------------------------- fallback --
+    def _next_candidate(self):
+        if self._pending:
+            return self._pending.pop(0)
+        if not self._dp_tried:
+            self._dp_tried = True
+            return "dp_full_remat"
+        return None
+
+    def _apply(self, cand, reason: str = "") -> None:
+        """Recompile the model under the fallback candidate, preserving the
+        live weights host-staged across the hop (pre-fit weight edits must
+        survive; shapes are strategy-independent)."""
+        import jax
+
+        model = self.model
+        from_desc = (model.strategy.describe()
+                     if model.strategy is not None else "?")
+        host = {ln: {wn: np.asarray(a) for wn, a in ws.items()}
+                for ln, ws in model.params.items()}
+        if cand == "dp_full_remat":
+            n_dev = len(jax.devices())
+            from ..parallel.strategy import data_parallel_strategy
+
+            def strategy_fn(pcg):
+                s = data_parallel_strategy(pcg, n_dev)
+                s.remat = "full"
+                return s
+
+            to_desc = f"mesh=({n_dev},) remat=full"
+        else:
+            from ..parallel.strategy import Strategy
+
+            text = cand.strategy_json
+
+            def strategy_fn(pcg):
+                return Strategy.from_json(text, pcg)
+
+            to_desc = cand.describe()
+        model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
+                      metrics=(model.metrics_obj.measures
+                               if model.metrics_obj else None),
+                      strategy_fn=strategy_fn)
+        # counted/emitted only once the hop actually took effect — a
+        # candidate that fails to compile joins the diagnosis instead
+        self.fallbacks += 1
+        self.tracer.event("strategy_fallback", from_strategy=from_desc,
+                          to_strategy=to_desc, reason=reason[:300],
+                          fallback=self.fallbacks)
+        for ln, ws in host.items():
+            for wn, a in ws.items():
+                cur = model.params.get(ln, {}).get(wn)
+                if cur is not None and np.asarray(cur).shape == a.shape:
+                    model.params[ln][wn] = jax.device_put(
+                        a, cur.sharding if hasattr(cur, "sharding")
+                        else None)
+        model.opt_state = model.optimizer.init_state(model.params)
+
+    # ---------------------------------------------------------- telemetry --
+    def merge_telemetry(self, telemetry) -> None:
+        if telemetry is None:
+            return
+        telemetry.strategy_fallbacks += self.fallbacks
+        telemetry.audit_runs += self.audits
+        telemetry.audit_failures += self.audit_failures
+        telemetry.final_strategy = self.final_desc

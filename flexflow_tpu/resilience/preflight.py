@@ -1,0 +1,263 @@
+"""Preflight validation: reject doomed plans BEFORE burning compile time.
+
+Production TPU stacks run cheap static checks before committing a job to
+hours of compilation and accelerator time (MegaScale-style preflight); the
+reference instead discovers a bad MachineView or a mis-shaped batch as a
+Legion mapping failure deep inside the run. This module is the TPU-native
+preflight (ISSUE 5):
+
+* ``preflight_strategy`` — strategy-vs-machine divisibility: mesh size vs
+  visible devices, batch vs data-parallel degree, every PartitionSpec axis
+  exists in the mesh, sharded weight/output dims divide their axis size,
+  hybrid ICI x DCN factors multiply out, pipeline grid sanity, remat level.
+  Run by ``FFModel.compile`` on explicit / imported strategies (the
+  untrusted inputs — searched strategies are divisible by construction)
+  and by the fallback cascade on every candidate it considers.
+* ``preflight_config`` — flag-combination sanity that needs the assembled
+  config (``--resume auto`` without a checkpoint dir, non-positive
+  ``--audit-tol``, retention that would delete the checkpoint resume
+  needs). Parse-time single-flag validation lives in ``config.parse_args``.
+* ``validate_batch`` — fit/eval/predict input arrays vs the compiled
+  signature: rank, per-axis shape, dtype kind, consistent sample counts —
+  a clear ``ValueError`` naming the offending tensor and axis instead of a
+  cryptic XLA shape error mid-epoch.
+
+All failures raise :class:`PreflightError` (a ``ValueError``) whose message
+says what to change. See ``docs/strategy_safety.md``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class PreflightError(ValueError):
+    """A strategy / flag / batch combination that cannot run; the message
+    is actionable (names the offending piece and what to change)."""
+
+
+# ----------------------------------------------------------------- config
+def preflight_config(config) -> None:
+    """Flag-combination sanity (ISSUE 5 satellite): everything here would
+    otherwise fail mid-run with a far less helpful error."""
+    fb = (getattr(config, "strategy_fallback", "on") or "on")
+    if fb not in ("on", "off"):
+        raise PreflightError(
+            f"--strategy-fallback expects on|off, got {fb!r}")
+    tol = getattr(config, "audit_tol", 0.05)
+    if tol is not None and float(tol) <= 0:
+        raise PreflightError(
+            f"--audit-tol must be > 0 (got {tol}): the audit compares "
+            "relative loss/grad-norm error against it")
+    if int(getattr(config, "memory_budget_mb", 0) or 0) < 0:
+        raise PreflightError(
+            "--memory-budget-mb must be >= 0 (0 disables the compile-time "
+            "OOM check)")
+    if getattr(config, "checkpoint_dir", "") and \
+            int(getattr(config, "keep_checkpoints", 3) or 0) < 1:
+        raise PreflightError(
+            "--keep-checkpoints must keep at least 1 committed checkpoint; "
+            "retention 0 would delete the checkpoint --resume and the "
+            "divergence sentinel roll back to")
+    if (getattr(config, "resume", "") or "").strip() == "auto" and \
+            not getattr(config, "checkpoint_dir", ""):
+        raise PreflightError(
+            "--resume auto needs --checkpoint-dir to know where committed "
+            "checkpoints live; pass --checkpoint-dir DIR or give --resume "
+            "an explicit step_N checkpoint path")
+    remat = (getattr(config, "remat", "") or "")
+    if remat and remat not in ("none", "selective", "full"):
+        raise PreflightError(
+            f"--remat expects none|selective|full, got {remat!r}")
+
+
+# --------------------------------------------------------------- strategy
+def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int) -> None:
+    """Static divisibility audit of a Strategy against the machine it is
+    about to compile for. Raises :class:`PreflightError` with the offending
+    node / axis named; a passing strategy may still fail XLA (that is what
+    the fallback cascade's compile check is for) but cannot fail on any of
+    the arithmetic checked here."""
+    ms = tuple(int(s) for s in strategy.mesh_shape)
+    axes = tuple(strategy.axis_names)
+    if len(axes) != len(ms):
+        raise PreflightError(
+            f"strategy mesh {ms} has {len(ms)} dims but axis_names {axes} "
+            f"names {len(axes)}; every mesh dim needs exactly one axis name")
+    if len(set(axes)) != len(axes):
+        raise PreflightError(f"strategy axis_names {axes} contain "
+                             "duplicates; mesh axes must be distinct")
+    need = int(np.prod(ms)) if ms else 1
+    if need > n_dev:
+        raise PreflightError(
+            f"strategy needs {need} devices (mesh {ms}) but only {n_dev} "
+            "are visible; re-run the search on this machine, pass a "
+            "smaller --mesh-shape, or restore a checkpointed run via "
+            "resilience.elastic_restore (re-plans for the surviving "
+            "devices)")
+    if strategy.data_axis not in axes:
+        raise PreflightError(
+            f"strategy data_axis {strategy.data_axis!r} is not one of the "
+            f"mesh axes {axes}")
+    dp = ms[axes.index(strategy.data_axis)]
+    if dp and batch_size % dp:
+        raise PreflightError(
+            f"batch size {batch_size} is not divisible by the "
+            f"data-parallel degree {dp} of mesh {ms}; use a batch that is "
+            f"a multiple of {dp} or a strategy whose dp divides the batch")
+    if strategy.hybrid:
+        ici, dcn = strategy.hybrid
+        if len(ici) != len(ms) or len(dcn) != len(ms) or any(
+                int(i) * int(d) != m for i, d, m in zip(ici, dcn, ms)):
+            raise PreflightError(
+                f"hybrid layout ici={tuple(ici)} x dcn={tuple(dcn)} does "
+                f"not factor the mesh {ms}: each axis needs "
+                "ici[i] * dcn[i] == mesh_shape[i]")
+    if strategy.remat and strategy.remat not in ("none", "selective",
+                                                 "full"):
+        raise PreflightError(
+            f"strategy remat level {strategy.remat!r} is not one of "
+            "none|selective|full")
+    if strategy.pipeline:
+        pp, pdp, micro = (int(v) for v in strategy.pipeline)
+        if pp < 2:
+            raise PreflightError(
+                f"pipeline grid {strategy.pipeline}: pp must be >= 2 "
+                "(pp=1 is plain SPMD — drop the pipeline field)")
+        if pp * pdp > n_dev:
+            raise PreflightError(
+                f"pipeline grid pp={pp} x dp={pdp} needs {pp * pdp} "
+                f"devices but only {n_dev} are visible")
+        if micro < 1 or batch_size % micro or (batch_size // micro) % \
+                max(pdp, 1):
+            raise PreflightError(
+                f"pipeline grid {strategy.pipeline}: batch {batch_size} "
+                f"must split into {micro} microbatches each divisible by "
+                f"dp={pdp}")
+
+    axis_size = dict(zip(axes, ms))
+
+    def check_spec(where: str, spec, shape) -> None:
+        for dim, e in enumerate(spec or ()):
+            names = e if isinstance(e, (tuple, list)) else (e,)
+            for a in names:
+                if a is None:
+                    continue
+                if a not in axis_size:
+                    raise PreflightError(
+                        f"{where}: PartitionSpec names mesh axis {a!r} "
+                        f"(dim {dim}) but the strategy's mesh axes are "
+                        f"{axes}")
+                sz = axis_size[a]
+                if shape is not None and dim < len(shape) and sz > 1 and \
+                        shape[dim] % sz:
+                    raise PreflightError(
+                        f"{where}: dim {dim} has size {shape[dim]}, not "
+                        f"divisible by mesh axis {a!r} (size {sz}); the "
+                        "plan cannot shard it evenly")
+
+    for guid, ns in strategy.node_strategies.items():
+        node = pcg.nodes.get(guid) if pcg is not None else None
+        name = node.name if node is not None else f"node guid {guid}"
+        wshapes = {}
+        if node is not None and ns.weight_specs:
+            try:
+                in_shapes = [pcg.nodes[g].out_shapes[i]
+                             for g, i in node.inputs]
+                wshapes = {w: tuple(s) for w, (s, _d, _i) in
+                           node.op.weight_specs(in_shapes).items()}
+            except Exception:
+                wshapes = {}
+        for wname, spec in (ns.weight_specs or {}).items():
+            check_spec(f"{name}.{wname}", spec, wshapes.get(wname))
+        if ns.output_spec:
+            oshape = (tuple(node.out_shapes[0])
+                      if node is not None and node.out_shapes else None)
+            check_spec(f"{name} output", ns.output_spec, oshape)
+
+
+# ------------------------------------------------------------------ batch
+_KIND_NAMES = {"f": "floating", "i": "integer", "u": "integer",
+               "b": "boolean", "c": "complex"}
+
+
+def _kind(dt: np.dtype) -> str:
+    k = np.dtype(dt).kind
+    if k in ("f", "V"):  # bfloat16 surfaces as a void-kind numpy dtype
+        return "f"
+    if k in ("i", "u"):
+        return "i"
+    return k
+
+
+def validate_batch(ffmodel, xs: Sequence[Any], y: Optional[Any] = None,
+                   phase: str = "fit") -> None:
+    """Validate fit/eval/predict arrays against the compiled signature
+    (ISSUE 5 satellite): a mis-shaped or mis-typed batch raises a clear
+    ``ValueError`` naming the offending tensor and axis here, instead of a
+    cryptic XLA shape/dtype error mid-epoch."""
+    from ..ffconst import dtype_to_jnp
+
+    input_nodes = ffmodel.pcg.input_nodes()
+    if len(xs) != len(input_nodes):
+        names = [n.name for n in input_nodes]
+        raise ValueError(
+            f"{phase}: model has {len(input_nodes)} input tensor(s) "
+            f"{names} but got {len(xs)} array(s)")
+    n0 = None
+    first_name = None
+    for node, a in zip(input_nodes, xs):
+        a = np.asarray(a)
+        want = tuple(node.out_shapes[0])
+        got = tuple(a.shape)
+        if len(got) != len(want):
+            raise ValueError(
+                f"{phase}: batch for input '{node.name}' has rank "
+                f"{len(got)} (shape {got}) but the compiled signature "
+                f"expects rank {len(want)} (declared shape {want}, leading "
+                "axis = batch)")
+        for ax in range(1, len(want)):
+            if got[ax] != int(want[ax]):
+                raise ValueError(
+                    f"{phase}: batch for input '{node.name}' mismatches "
+                    f"the compiled signature on axis {ax}: got {got[ax]} "
+                    f"(shape {got}), expected {want[ax]} (declared shape "
+                    f"{want})")
+        want_dt = np.dtype(dtype_to_jnp(node.out_dtypes[0]))
+        if _kind(a.dtype) != _kind(want_dt):
+            raise ValueError(
+                f"{phase}: batch for input '{node.name}' has "
+                f"{_KIND_NAMES.get(_kind(a.dtype), _kind(a.dtype))} dtype "
+                f"{a.dtype} but the compiled signature expects a "
+                f"{_KIND_NAMES.get(_kind(want_dt), _kind(want_dt))} tensor "
+                f"({want_dt.name}); cast the array before {phase}")
+        if n0 is None:
+            n0, first_name = got[0], node.name
+        elif got[0] != n0:
+            raise ValueError(
+                f"{phase}: input '{node.name}' has {got[0]} samples but "
+                f"'{first_name}' has {n0}; all inputs must share the "
+                "leading batch axis")
+    if y is None:
+        return
+    y = np.asarray(y)
+    if n0 is not None and y.shape[0] != n0:
+        raise ValueError(
+            f"{phase}: label batch has {y.shape[0]} samples but the "
+            f"inputs have {n0}; labels must share the leading batch axis")
+    lt = getattr(ffmodel, "label_tensor", None)
+    from ..ffconst import LossType
+
+    sparse = (getattr(ffmodel, "loss_type", None) ==
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    if lt is not None and not sparse and \
+            not getattr(ffmodel.executor, "repl_labels", False):
+        want_tail = tuple(d for d in tuple(lt.dims)[1:] if d != 1)
+        got_tail = tuple(d for d in y.shape[1:] if d != 1)
+        if got_tail != want_tail:
+            raise ValueError(
+                f"{phase}: label batch shape {tuple(y.shape)} mismatches "
+                f"the compiled label signature {tuple(lt.dims)} (trailing "
+                f"dims {got_tail} != {want_tail}); check the loss target "
+                "shape")

@@ -100,6 +100,38 @@ class SearchSpace:
 
 
 @dataclasses.dataclass
+class RankedCandidate:
+    """One entry of ``SearchResult.ranked`` — the strategy-safety layer's
+    fallback chain (ISSUE 5). ``strategy_json`` is the candidate's Strategy
+    serialized against its OWN (possibly rewritten) graph, so a fallback
+    compile can re-map it by node name onto a freshly built PCG
+    (``Strategy.from_json``); the winner (rank 0) and pipeline candidates
+    carry None — the winner is already compiled, and the GPipe trainer is
+    outside the cascade's SPMD re-entry path."""
+
+    mesh_shape: Tuple[int, int]
+    dcn: Tuple[int, int] = (1, 1)
+    remat: str = "none"
+    sim_time: float = 0.0
+    sim_memory: int = 0
+    feasible: bool = True
+    pipeline: Optional[Tuple[int, int, int]] = None
+    strategy_json: Optional[str] = None
+
+    def describe(self) -> str:
+        # same vocabulary as Strategy.describe(), so a plan reads the same
+        # in fallback events whether described from the chain or the model
+        bits = [f"mesh={tuple(self.mesh_shape)}"]
+        if self.pipeline:
+            bits.append(f"pipeline={tuple(self.pipeline)}")
+        if self.remat and self.remat != "none":
+            bits.append(f"remat={self.remat}")
+        if tuple(self.dcn) != (1, 1):
+            bits.append(f"dcn={tuple(self.dcn)}")
+        return " ".join(bits)
+
+
+@dataclasses.dataclass
 class SearchResult:
     strategy: Strategy
     assignment: Dict[int, OpSharding]
@@ -121,6 +153,11 @@ class SearchResult:
     search_wall_s: Optional[float] = None
     candidates: int = 0
     cache_stats: Optional[Dict] = None
+    # ranked top-K candidate chain (ISSUE 5): rank 0 is the winner; the
+    # rest are the best distinct runners-up, each restorable by name via
+    # strategy_json — what the executor's fallback cascade degrades
+    # through when the winner fails to compile / OOMs / fails the audit
+    ranked: List[RankedCandidate] = dataclasses.field(default_factory=list)
 
 
 def dcn_placements(dp: int, tp: int, num_hosts: int
@@ -1167,6 +1204,66 @@ def best_first_optimize(pcg: PCG, sim: Simulator, dp: int, tp: int,
     return best
 
 
+# ----------------------------------------------------------- ranked top-K
+# fallback-chain length the search persists (winner + K-1 runners-up); the
+# cascade rarely needs more than a couple before the dp+full-remat last
+# resort, and each extra entry costs one strategy JSON serialization
+RANKED_TOP_K = 5
+
+
+def _build_ranked(best: SearchResult,
+                  spmd_pool: Dict[Tuple, Tuple[bool, SearchResult]],
+                  pipe_cands: List[RankedCandidate],
+                  mem_budget: Optional[int], k: int = RANKED_TOP_K
+                  ) -> List[RankedCandidate]:
+    """Collapse the deduped candidate pool into the ranked fallback chain:
+    one best entry per (mesh, dcn, remat | pipeline grid), runners-up
+    ordered feasible-first by simulated time (ties broken on the plan key,
+    so the ranking is deterministic). ``spmd_pool`` is maintained
+    incrementally by the search (one retained SearchResult per plan key),
+    so a long memory search never accumulates per-λ graph copies."""
+    entries: Dict[Tuple, Tuple[bool, float, int, Optional[SearchResult],
+                               Optional[RankedCandidate]]] = {}
+
+    def consider(key, feas, t, mem, res, pre):
+        cur = entries.get(key)
+        if cur is None or (feas and not cur[0]) or \
+                (feas == cur[0] and t < cur[1]):
+            entries[key] = (feas, t, mem, res, pre)
+
+    for (mesh, dcn, remat), (feas, r) in spmd_pool.items():
+        consider((mesh, dcn, remat, None), feas, r.sim_time, r.sim_memory,
+                 r, None)
+    for c in pipe_cands:
+        consider((tuple(c.mesh_shape), tuple(c.dcn), c.remat,
+                  tuple(c.pipeline)), c.feasible, c.sim_time, c.sim_memory,
+                 None, c)
+
+    win_pipe = (tuple(best.strategy.pipeline)
+                if getattr(best.strategy, "pipeline", None) else None)
+    win_key = (tuple(best.mesh_shape), tuple(best.dcn), best.remat, win_pipe)
+    ranked = [RankedCandidate(
+        mesh_shape=tuple(best.mesh_shape), dcn=tuple(best.dcn),
+        remat=best.remat, sim_time=best.sim_time, sim_memory=best.sim_memory,
+        feasible=bool(mem_budget is None or best.sim_memory <= mem_budget),
+        pipeline=win_pipe)]
+    others = sorted(((key, v) for key, v in entries.items()
+                     if key != win_key),
+                    key=lambda kv: (not kv[1][0], kv[1][1], repr(kv[0])))
+    for key, (feas, t, mem, res, pre) in others[:max(k - 1, 0)]:
+        if pre is not None:
+            ranked.append(pre)
+            continue
+        sjson = None
+        if res is not None and res.pcg is not None:
+            sjson = res.strategy.to_json(res.pcg)
+        ranked.append(RankedCandidate(
+            mesh_shape=key[0], dcn=key[1], remat=key[2],
+            sim_time=t, sim_memory=mem, feasible=feas,
+            strategy_json=sjson))
+    return ranked
+
+
 # ------------------------------------------------------------------ top level
 def unity_search(pcg: PCG, config, n_dev: int,
                  machine: Optional[TPUMachineModel] = None,
@@ -1256,6 +1353,21 @@ def unity_search(pcg: PCG, config, n_dev: int,
     slog = SearchLog(getattr(config, "search_log_file", "") or None,
                      kind="unity")
 
+    # deduped candidate pool for the ranked fallback chain (ISSUE 5): one
+    # retained SearchResult per (mesh, dcn, remat) — folding each sweep in
+    # incrementally keeps retention O(distinct plans), not O(λ iterations)
+    ranked_pool: Dict[Tuple, Tuple[bool, SearchResult]] = {}
+    rank_budget = hbm_budget if config.perform_memory_search else None
+    pipe_cands: List[RankedCandidate] = []
+
+    def pool_consider(r: SearchResult) -> None:
+        feas = rank_budget is None or r.sim_memory <= rank_budget
+        key = (tuple(r.mesh_shape), tuple(r.dcn), r.remat)
+        cur = ranked_pool.get(key)
+        if cur is None or (feas and not cur[0]) or \
+                (feas == cur[0] and r.sim_time < cur[1].sim_time):
+            ranked_pool[key] = (feas, r)
+
     def search_all(lam: float, mem_budget: Optional[int] = None
                    ) -> Optional[SearchResult]:
         """One sweep over factorizations at a fixed λ. With a memory budget,
@@ -1310,6 +1422,8 @@ def unity_search(pcg: PCG, config, n_dev: int,
                         mesh_shape=(dp, tp), pcg=g, states=s,
                         dcn=(dp_dcn, tp_dcn), remat=remat))
         sim.set_axis_topology(1, 1)
+        for r in results:
+            pool_consider(r)
         if not results:
             return None
         if mem_budget is not None:
@@ -1399,6 +1513,15 @@ def unity_search(pcg: PCG, config, n_dev: int,
                     pipe_ok = t_pipe < best.sim_time and (
                         not config.perform_memory_search or
                         m_pipe <= hbm_budget)
+                    # mesh recorded as the winner convention (n_dev, 1) so
+                    # an accepted grid's entry dedupes against its own
+                    # SearchResult in the ranking
+                    pipe_cands.append(RankedCandidate(
+                        mesh_shape=(n_dev, 1), remat=lv, sim_time=t_pipe,
+                        sim_memory=m_pipe,
+                        feasible=bool(not config.perform_memory_search
+                                      or m_pipe <= hbm_budget),
+                        pipeline=(pp, pdp, micro)))
                     slog.log(event="pipeline_candidate", pp=pp, dp=pdp,
                              n_micro=micro, remat=lv,
                              cost_ms=round(t_pipe * 1e3, 4),
@@ -1438,6 +1561,19 @@ def unity_search(pcg: PCG, config, n_dev: int,
         best.search_wall_s = search_wall_s
         best.candidates = candidates
         best.cache_stats = cache_stats
+        # ranked fallback chain (ISSUE 5): persisted on the result AND in
+        # the search log, so the compile-time cascade (and a post-mortem of
+        # one) can replay which plans were next in line
+        best.ranked = _build_ranked(best, ranked_pool, pipe_cands,
+                                    rank_budget)
+        slog.log(event="ranked", candidates=[
+            {"rank": i, "mesh": list(c.mesh_shape), "dcn": list(c.dcn),
+             "remat": c.remat,
+             "pipeline": list(c.pipeline) if c.pipeline else None,
+             "cost_ms": round(c.sim_time * 1e3, 4),
+             "mem_mib": round(c.sim_memory / 2 ** 20, 1),
+             "feasible": bool(c.feasible)}
+            for i, c in enumerate(best.ranked)])
         slog.log(event="result", cost_ms=round(best.sim_time * 1e3, 4),
                  mem_mib=round(best.sim_memory / 2 ** 20, 1),
                  mesh=list(best.mesh_shape), remat=best.remat,

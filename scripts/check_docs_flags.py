@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Assert every CLI flag flexflow_tpu/config.py parses is documented.
+
+Flag/doc drift is a classic silent failure: a new ``--flag`` lands in
+``FFConfig.parse_args`` and nobody can discover it because
+``docs/python_api.md`` never heard of it. This checker extracts every flag
+literal from config.py (the manual reference-compatible parser — the
+repo's argparse equivalent) and requires each to appear verbatim in the
+flag documentation. Wired into tier-1 via
+``tests/test_housekeeping_r8.py`` so drift fails CI.
+
+Usage: python scripts/check_docs_flags.py [CONFIG_PY] [DOC_MD]
+Exit status: 0 when every flag is documented, 1 otherwise (missing flags
+are listed on stderr).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_CONFIG = os.path.join(_REPO, "flexflow_tpu", "config.py")
+DEFAULT_DOC = os.path.join(_REPO, "docs", "python_api.md")
+
+# flag-shaped string literals: --long-flag, -x short flags, and the
+# Legion-style -ll:* / -lg:* resource flags kept for reference parity
+_FLAG_RE = re.compile(
+    r'"(--[a-z][a-z0-9-]*|-[a-z]|-ll:[a-z]+|-lg:[a-z_]+)"')
+
+
+def flags_in_config(path: str) -> set:
+    with open(path) as f:
+        src = f.read()
+    # only the parser body counts — the module docstring mentions flag
+    # style, not concrete flags, and is allowed to lag
+    m = re.search(r"def parse_args\b.*?(?=\n    def |\nclass |\Z)", src,
+                  re.S)
+    body = m.group(0) if m else src
+    return set(_FLAG_RE.findall(body))
+
+
+def documented_in(text: str, flag: str) -> bool:
+    """Whole-token containment: ``--budget`` must not be satisfied by
+    ``--budget-mb`` and vice versa."""
+    return re.search(r"(?<![\w-])" + re.escape(flag) + r"(?![\w-])",
+                     text) is not None
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    config_py = argv[0] if argv else DEFAULT_CONFIG
+    doc_md = argv[1] if len(argv) > 1 else DEFAULT_DOC
+    parsed = flags_in_config(config_py)
+    with open(doc_md) as f:
+        doc_text = f.read()
+    missing = sorted(f for f in parsed if not documented_in(doc_text, f))
+    if missing:
+        print(f"{doc_md}: {len(missing)} flag(s) parsed by {config_py} "
+              "are undocumented:", file=sys.stderr)
+        for f in missing:
+            print(f"  {f}", file=sys.stderr)
+        print("add each to the command-line flags section of "
+              "docs/python_api.md", file=sys.stderr)
+        return 1
+    print(f"ok: all {len(parsed)} flags in {os.path.basename(config_py)} "
+          f"are documented in {os.path.basename(doc_md)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

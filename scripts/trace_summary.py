@@ -125,6 +125,16 @@ def summarize_telemetry(data, top: int) -> None:
         if res.get("last_resume_step") is not None:
             line += f"   last resume at step {res['last_resume_step']}"
         print(line)
+    ss = data.get("strategy_safety")
+    if ss:
+        # strategy-safety headline (ISSUE 5): did the plan survive its
+        # verification, and which strategy did the run actually train under
+        line = (f"strategy fallbacks: {ss.get('fallbacks', 0)}   "
+                f"audits: {ss.get('audit_runs', 0)} "
+                f"({ss.get('audit_failures', 0)} failed)")
+        if ss.get("final_strategy"):
+            line += f"   final strategy: {ss['final_strategy']}"
+        print(line)
     losses = data.get("loss_history", [])
     if losses:
         show = losses[:top]
