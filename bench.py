@@ -219,7 +219,8 @@ def main():
                 ("memory_pressure_search_leg", memory_pressure_search_leg),
                 ("memsearch_remat_leg",
                  lambda: memsearch_remat_leg(cfg, result)),
-                ("resume_overhead_leg", lambda: resume_overhead_leg(cfg))]
+                ("resume_overhead_leg", lambda: resume_overhead_leg(cfg)),
+                ("serving_leg", serving_leg)]
         for name, leg in legs:
             with tracer.span(name):
                 result.update(leg())
@@ -431,6 +432,70 @@ def resume_overhead_leg(cfg) -> dict:
         out["ckpt_committed"] = saved
     except Exception as e:
         out["resume_overhead_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def serving_leg() -> dict:
+    """Serving engine leg (ISSUE 6, docs/serving.md): measured tokens/sec,
+    p50/p99 per-token latency and batch-occupancy for GPT-2-small greedy
+    generation through the continuous-batching engine on one chip, plus
+    the serving-objective search's simulated plan at 8 chips against naive
+    data-parallel replication (the tokens/sec-at-SLO headline the training
+    legs' MFU plays for fit())."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.serving import ServingEngine, serving_search
+
+    out = {}
+    try:
+        cfg = GPT2Config(batch_size=8, seq_len=256, hidden=768,
+                         num_heads=12, num_layers=12, intermediate=3072,
+                         vocab_size=50257)
+        config = FFConfig()
+        config.batch_size = cfg.batch_size
+        config.max_decode_len = 256
+        config.max_inflight = 8
+        ff = FFModel(config)
+        build_gpt2(ff, cfg)
+        ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        eng = ServingEngine(ff, n_slots=8, max_decode_len=256)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                size=int(rng.integers(24, 96))).tolist()
+                   for _ in range(24)]
+        eng.generate(prompts, max_new_tokens=64)
+        st = eng.stats
+        out["serving_tokens_per_s"] = round(st.tokens_per_s(), 1)
+        p50, p99 = st.p50_token_ms(), st.p99_token_ms()
+        if p50 is not None:
+            out["serving_p50_token_ms"] = round(p50, 3)
+            out["serving_p99_token_ms"] = round(p99, 3)
+        out["serving_batch_occupancy"] = round(
+            st.batch_occupancy(eng.n_slots), 3)
+        out["serving_requests"] = st.requests_served
+        out["serving_decode_compiles"] = eng.decode_compiles
+        # simulated serving objective at 8 chips: the searched plan's
+        # tokens/sec against naive dp replication (ranked always carries
+        # the (8, 1) replicated point)
+        plan = serving_search(ff.pcg, config, 8,
+                              machine=TPUMachineModel.from_generation(
+                                  "v5e", 8))
+        out["serving_sim_tokens_per_s"] = round(plan.sim_tokens_per_s, 1)
+        out["serving_sim_p99_ms"] = round(plan.sim_p99_ms, 3)
+        out["serving_sim_mesh"] = list(plan.mesh_shape)
+        out["serving_sim_kv_layout"] = plan.layout
+        naive = [c for c in plan.ranked
+                 if tuple(c.mesh_shape) == (8, 1)]
+        if naive:
+            out["serving_sim_vs_naive_dp"] = round(
+                plan.sim_tokens_per_s / naive[0].sim_tokens_per_s, 3)
+    except Exception as e:
+        out["serving_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

@@ -30,6 +30,18 @@ def main(argv=None, cfg=None):
     x = stream[:, :-1].astype(np.int32)
     y = stream[:, 1:].astype(np.int32)  # next-token targets
     perf = ff.fit(x, y)
+    if config.serve:
+        # --serve (ISSUE 6, docs/serving.md): after training, serve a few
+        # continuations through the prefill/decode engine — training and
+        # serving on the same compiled model, same process
+        prompts = [row[: cfg.seq_len // 4].tolist() for row in x[:4]]
+        outs = ff.generate(prompts,
+                           max_new_tokens=min(8, config.max_decode_len // 2),
+                           max_decode_len=min(config.max_decode_len,
+                                              cfg.seq_len),
+                           max_inflight=min(config.max_inflight, 4))
+        for i, o in enumerate(outs):
+            print(f"SERVE request {i}: generated={o}")
     return ff, perf
 
 

@@ -24,5 +24,6 @@ from .parallel.pipeline import PipelineTrainer  # noqa: F401,E402
 from .execution.checkpoint import (latest_checkpoint,  # noqa: F401,E402
                                    restore_checkpoint, save_checkpoint)
 from .resilience import ChaosPlan, elastic_restore  # noqa: F401,E402
+from .serving import ServingEngine  # noqa: F401,E402
 
 __version__ = "0.1.0"

@@ -163,6 +163,20 @@ class FFConfig:
     # fallback cascade rather than the search)
     memory_budget_mb: int = 0
 
+    # serving engine (flexflow_tpu/serving, docs/serving.md; ISSUE 6).
+    # The reference's only inference artifact is an incomplete Triton
+    # prototype — these knobs drive the JAX serving path instead.
+    serve: bool = False          # run the examples' serve mode after compile
+    # decode-state ring-buffer capacity per slot: prompt + generated tokens
+    # must fit; also the largest prefill bucket
+    max_decode_len: int = 128
+    # continuous-batching decode slots (the in-flight request ceiling);
+    # also the serving search's total-slot budget
+    max_inflight: int = 8
+    # serving-objective SLO: simulated p99 per-token latency bound (ms) for
+    # search_all(objective="serving"); 0 = throughput-only
+    slo_p99_ms: float = 0.0
+
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
     mesh_axis_names: Sequence[str] = ("data", "model")
@@ -309,6 +323,14 @@ class FFConfig:
                 self.audit_tol = float(_next())
             elif a == "--memory-budget-mb":
                 self.memory_budget_mb = int(_next())
+            elif a == "--serve":
+                self.serve = True
+            elif a == "--max-decode-len":
+                self.max_decode_len = int(_next())
+            elif a == "--max-inflight":
+                self.max_inflight = int(_next())
+            elif a == "--slo-p99-ms":
+                self.slo_p99_ms = float(_next())
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -368,6 +390,19 @@ class FFConfig:
             raise ValueError(
                 f"--memory-budget-mb must be >= 0 (got "
                 f"{self.memory_budget_mb}); 0 disables the check")
+        if "--max-decode-len" in seen and self.max_decode_len < 1:
+            raise ValueError(
+                f"--max-decode-len must be >= 1 (got "
+                f"{self.max_decode_len}): it is the decode ring-buffer "
+                "capacity every prompt + generation must fit")
+        if "--max-inflight" in seen and self.max_inflight < 1:
+            raise ValueError(
+                f"--max-inflight must be >= 1 (got {self.max_inflight}): "
+                "the serving engine needs at least one decode slot")
+        if "--slo-p99-ms" in seen and self.slo_p99_ms < 0:
+            raise ValueError(
+                f"--slo-p99-ms must be >= 0 (got {self.slo_p99_ms}); "
+                "0 disables the latency bound")
         if "--resume" in seen:
             if self.resume == "auto" and not self.checkpoint_dir:
                 raise ValueError(

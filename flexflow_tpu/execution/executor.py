@@ -45,6 +45,10 @@ class Executor:
         self._eval_step = None
         self._forward_jit = None
         self._probe_step = None
+        # serving engine jits (ISSUE 6): {("prefill", bucket_len, max_len) |
+        # ("decode", max_len): jitted fn} — one compile per prefill bucket
+        # plus ONE decode compile, the engine's recompile-free contract
+        self._serving_jits: Dict[Tuple, Any] = {}
         # the RematPlan make_train_step resolved and applied (None until
         # built, and None when remat is off/ineligible) — telemetry reads it
         self.remat_plan = None
@@ -193,7 +197,8 @@ class Executor:
                  if ctx.rng is not None else None),
             seq_length=ctx.seq_length, mesh=ctx.mesh,
             profiling=ctx.profiling, aux_losses=ctx.aux_losses,
-            cache_in=ctx.cache_in, cache_out=ctx.cache_out)
+            cache_in=ctx.cache_in, cache_out=ctx.cache_out,
+            serving=ctx.serving)
         with jax.named_scope(node.name):
             outs = node.op.forward(node_params, inputs, node_ctx)
         # apply the strategy's output sharding constraint (parallel ops and
@@ -206,14 +211,25 @@ class Executor:
         return outs
 
     def forward_outputs(self, params, bound_inputs: Dict[int, Any],
-                        ctx: OpContext) -> Dict[int, List[Any]]:
-        """Run the graph; returns {node_guid: [outputs]}."""
+                        ctx: OpContext,
+                        overrides: Optional[Dict[int, List[Any]]] = None
+                        ) -> Dict[int, List[Any]]:
+        """Run the graph; returns {node_guid: [outputs]}.
+
+        ``overrides`` substitutes the outputs of specific compute nodes
+        without executing them — the serving engine's hook for replacing
+        baked position-id constants with the live per-slot positions
+        (serving/kvcache.is_position_constant). None on every training
+        path."""
         values: Dict[int, List[Any]] = {}
         for node in self.pcg.topo_order():
             op = node.op
             if op.op_type in (OperatorType.OP_INPUT,
                               OperatorType.OP_WEIGHT):
                 values[node.guid] = [bound_inputs[node.guid]]
+                continue
+            if overrides is not None and node.guid in overrides:
+                values[node.guid] = overrides[node.guid]
                 continue
             inputs = [values[g][i] for g, i in node.inputs]
             values[node.guid] = self._exec_node(
@@ -271,19 +287,32 @@ class Executor:
                         if (g, i) in needed]
             names = [self.pcg.nodes[g].name for g in seg]
 
-            def make_fn(seg=seg, ext_refs=ext_refs, out_refs=out_refs):
-                def fn(block_params, ext_vals, rng):
+            # cache-stateful nodes of this block (reference: cache.cc):
+            # their fresh values leave the block as EXPLICIT outputs —
+            # the same no-host-side-mutation rule as aux losses. This is
+            # the ISSUE 6 inversion of the old "CacheOp graphs opt out of
+            # remat" rule: cache state threads through jax.checkpoint like
+            # any other block boundary value.
+            cache_names = [self.pcg.nodes[g].name for g in seg
+                           if self.pcg.nodes[g].op.op_type ==
+                           OperatorType.OP_CACHE]
+
+            def make_fn(seg=seg, ext_refs=ext_refs, out_refs=out_refs,
+                        cache_names=cache_names):
+                def fn(block_params, ext_vals, rng, cache_in):
                     import jax.numpy as jnp
 
                     values = dict(zip(ext_refs, ext_vals))
                     aux: List[Any] = []
+                    cache_out: Dict[str, Any] = {}
                     # block-local ctx: _exec_node folds the rng per node,
                     # exactly as the plain forward does (recompute replays
-                    # identical dropout masks); cache fields stay None —
-                    # CacheOp graphs never reach the remat path
+                    # identical dropout masks)
                     block_ctx = OpContext(training=True, rng=rng,
                                           mesh=mesh, profiling=profiling,
-                                          aux_losses=aux)
+                                          aux_losses=aux,
+                                          cache_in=cache_in,
+                                          cache_out=cache_out)
                     for g in seg:
                         node = self.pcg.nodes[g]
                         inputs = [values[(pg, i)] for pg, i in node.inputs]
@@ -296,13 +325,14 @@ class Executor:
                     # appending traced interiors to a host-side list from
                     # inside jax.checkpoint would leak residual tracers
                     aux_sum = sum(aux) if aux else jnp.zeros((), jnp.float32)
-                    return tuple(values[r] for r in out_refs), aux_sum
+                    return (tuple(values[r] for r in out_refs), aux_sum,
+                            tuple(cache_out[n] for n in cache_names))
                 return fn
 
             fn = make_fn()
             if policy is not None:
                 fn = jax.checkpoint(fn, policy=policy)
-            program.append((fn, ext_refs, out_refs, names, k))
+            program.append((fn, ext_refs, out_refs, names, k, cache_names))
         return program
 
     def _forward_remat(self, params, bound_inputs: Dict[int, Any],
@@ -313,13 +343,16 @@ class Executor:
         import jax
 
         values = {(g, 0): v for g, v in bound_inputs.items()}
-        for fn, ext_refs, out_refs, names, k in program:
+        for fn, ext_refs, out_refs, names, k, cache_names in program:
             block_params = {n: params[n] for n in names if n in params}
             ext_vals = tuple(values[r] for r in ext_refs)
             with jax.named_scope(f"remat_block_{k}"):
-                outs, aux = fn(block_params, ext_vals, ctx.rng)
+                outs, aux, cache_vals = fn(block_params, ext_vals, ctx.rng,
+                                           ctx.cache_in)
             if ctx.aux_losses is not None:
                 ctx.aux_losses.append(aux)
+            if ctx.cache_out is not None:
+                ctx.cache_out.update(zip(cache_names, cache_vals))
             values.update(zip(out_refs, outs))
         return values[(self.final_guid, self.final_out_idx)]
 
@@ -348,6 +381,7 @@ class Executor:
         self._eval_step = None
         self._forward_jit = None
         self._probe_step = None
+        self._serving_jits = {}
 
     def make_train_step(self, guard: bool = False):
         """One fused jitted step: forward + loss + grad + metrics + update
@@ -388,14 +422,10 @@ class Executor:
         plan = resolve_remat_plan(self.config, self.strategy)
         remat_program = None
         if plan.level != "none":
-            if has_cache:
-                import warnings
-
-                warnings.warn(
-                    "remat disabled for this model: CacheOps fill a "
-                    "host-side dict jax.checkpoint cannot trace through")
-            else:
-                remat_program = self._build_remat_program(plan)
+            # CacheOp graphs remat too (ISSUE 6 inversion of the old
+            # opt-out): cache state threads through the checkpointed
+            # blocks as explicit inputs/outputs
+            remat_program = self._build_remat_program(plan)
         self.remat_plan = plan if remat_program is not None else None
 
         def loss_fn(params, xs, labels, rng, cache):
@@ -561,3 +591,122 @@ class Executor:
 
         self._forward_jit = jax.jit(fwd)
         return self._forward_jit
+
+    # ------------------------------------------------------------- serving
+    # Prefill/decode split (ISSUE 6, flexflow_tpu/serving, docs/serving.md):
+    # the graph's one forward recipe lowers into TWO inference programs —
+    # a per-bucket prefill that populates the KV-cache pytree and ONE
+    # static-shape decode step that consumes/extends it. Both reuse
+    # forward_outputs (per-op named scopes, strategy output constraints,
+    # mixed-precision cast), so the serving path inherits every training-
+    # side op improvement for free.
+    def _position_const_guids(self) -> List[int]:
+        """Compute nodes holding the baked position-id constant (the
+        ``broadcast(arange(seq))`` pattern of models/gpt2.py) — serving
+        regenerates their value per phase via forward_outputs overrides."""
+        from ..serving.kvcache import is_position_constant
+
+        out = []
+        for node in self.pcg.compute_nodes():
+            if node.op.op_type == OperatorType.OP_CONSTANT and \
+                    is_position_constant(node.op.attrs.get("value")):
+                out.append(node.guid)
+        return out
+
+    def _serving_overrides(self, guids, value):
+        return {g: [value] for g in guids}
+
+    def make_prefill_step(self, bucket_len: int, max_decode_len: int):
+        """Jitted ``(params, xs, lengths) -> (logits, last_logits, cache)``:
+        run the whole right-padded prompt (padded to the scheduler's
+        ``bucket_len`` — one compile per bucket, not per prompt length),
+        populating a fresh ``max_decode_len`` KV ring buffer per stateful
+        node. ``lengths`` (batch,) are the true prompt lengths; the
+        returned ``last_logits`` (batch, vocab) are gathered at
+        ``lengths - 1`` (the next-token distribution), ``logits`` is the
+        full (batch, bucket_len, vocab) tensor for scoring/teacher-forcing
+        consumers."""
+        import jax
+
+        key = ("prefill", int(bucket_len), int(max_decode_len))
+        cached = self._serving_jits.get(key)
+        if cached is not None:
+            return cached
+        mesh = self.mesh
+        profiling = bool(getattr(self.config, "profiling", False))
+        pos_guids = self._position_const_guids()
+
+        from ..serving.kvcache import ServingState
+
+        def prefill(params, xs, lengths):
+            import jax.numpy as jnp
+
+            params, xs = self._cast_for_compute(params, xs)
+            lengths = lengths.astype(jnp.int32)
+            sv = ServingState(mode="prefill", max_len=max_decode_len,
+                              positions=jnp.zeros_like(lengths),
+                              lengths=lengths)
+            ctx = OpContext(training=False, rng=None, mesh=mesh,
+                            profiling=profiling, serving=sv)
+            b = xs[0].shape[0]
+            pos = jnp.broadcast_to(
+                jnp.arange(bucket_len, dtype=jnp.int32), (b, bucket_len))
+            values = self.forward_outputs(
+                params, self._bind_inputs(xs), ctx,
+                overrides=self._serving_overrides(pos_guids, pos))
+            logits = self._logits_f32(
+                values[self.final_guid][self.final_out_idx])
+            idx = jnp.clip(lengths - 1, 0, logits.shape[1] - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+            return logits, last, sv.cache_out
+
+        fn = jax.jit(prefill)
+        self._serving_jits[key] = fn
+        return fn
+
+    def make_decode_step(self, max_decode_len: int, exact: bool = False):
+        """Jitted ``(params, xs, state) -> (logits, new_state)``: ONE token
+        per slot through the graph, consuming and extending the
+        ``DecodeState`` ring buffers at each slot's ``lengths`` cursor.
+        Static shapes throughout — after the single warmup compile the
+        decode loop never recompiles (the engine asserts this via the jit
+        cache size). The state argument is donated: the ring buffers
+        update in place on device. ``exact=True`` selects the
+        bitwise-vs-full-forward attention numerics (ServingState.exact) at
+        a max_len-x score-compute premium — the verification mode the
+        equivalence tests run."""
+        import jax
+
+        key = ("decode", int(max_decode_len), bool(exact))
+        cached = self._serving_jits.get(key)
+        if cached is not None:
+            return cached
+        mesh = self.mesh
+        profiling = bool(getattr(self.config, "profiling", False))
+        pos_guids = self._position_const_guids()
+
+        from ..serving.kvcache import DecodeState, ServingState
+
+        def decode(params, xs, state):
+            import jax.numpy as jnp
+
+            params, xs = self._cast_for_compute(params, xs)
+            sv = ServingState(mode="decode", max_len=max_decode_len,
+                              positions=state.lengths,
+                              cache_in=state.caches, exact=exact)
+            ctx = OpContext(training=False, rng=None, mesh=mesh,
+                            profiling=profiling, serving=sv)
+            values = self.forward_outputs(
+                params, self._bind_inputs(xs), ctx,
+                overrides=self._serving_overrides(
+                    pos_guids, state.lengths[:, None]))
+            logits = self._logits_f32(
+                values[self.final_guid][self.final_out_idx])[:, 0]
+            new_state = DecodeState(caches=sv.cache_out,
+                                    lengths=state.lengths + 1)
+            return logits, new_state
+
+        fn = jax.jit(decode, donate_argnums=(2,))
+        self._serving_jits[key] = fn
+        return fn

@@ -1267,6 +1267,16 @@ class FFModel:
         return perf
 
     def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Batched inference forward (ISSUE 6 satellite). Two hot-path
+        fixes over the per-batch loop this replaces: the final non-full
+        batch from ``batch_iterator(drop_remainder=False)`` is PADDED to
+        the full batch size (repeating the last row) and trimmed
+        host-side — one jit specialization instead of a second compile for
+        the tail shape — and results stay on device until ONE
+        ``jax.device_get`` at the end instead of an ``np.asarray`` device
+        sync per batch (the same batching PerfMetrics got in PR 1)."""
+        import jax
+
         xs = self._as_input_list(x)
         batch_size = batch_size or self.config.batch_size
         from .resilience.preflight import validate_batch
@@ -1275,10 +1285,62 @@ class FFModel:
         fwd = self.executor.make_forward()
         from .data.dataloader import batch_iterator
 
+        # static rows-per-sample of the final output (nmt-style graphs
+        # flatten (b, t) -> b*t rows; trimming must drop whole samples)
+        final = self.pcg.nodes[self.final_guid]
+        out_rows = final.out_shapes[self.executor.final_out_idx][0]
+        in_rows = self.pcg.input_nodes()[0].out_shapes[0][0]
+        per_sample = out_rows // in_rows if in_rows and \
+            out_rows % in_rows == 0 else None
         outs = []
+        tail_rows = None
         for batch in batch_iterator(xs, batch_size, drop_remainder=False):
-            outs.append(np.asarray(fwd(self.params, batch)))
-        return np.concatenate(outs, axis=0)
+            nb = batch[0].shape[0]
+            if nb < batch_size:
+                if per_sample is None:
+                    # output rows don't divide per sample: a padded batch
+                    # could not be trimmed — pay the tail recompile
+                    outs.append(fwd(self.params, batch))
+                    continue
+                pad = batch_size - nb
+                batch = [np.concatenate([a, np.repeat(a[-1:], pad, axis=0)],
+                                        axis=0) for a in batch]
+                tail_rows = nb
+            outs.append(fwd(self.params, batch))
+        host = [np.asarray(o) for o in jax.device_get(outs)]
+        if tail_rows is not None:
+            host[-1] = host[-1][:tail_rows * per_sample]
+        return np.concatenate(host, axis=0)
+
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 max_inflight: Optional[int] = None,
+                 max_decode_len: Optional[int] = None) -> List[List[int]]:
+        """Autoregressive generation through the serving engine (ISSUE 6,
+        docs/serving.md): prefill/decode split with a KV-cache pytree and
+        continuous batching over ``--max-inflight`` decode slots. Greedy
+        when ``temperature <= 0``; otherwise top-k filtered sampling (the
+        Pallas top-k kernel where eligible). ``prompts`` is a list of
+        token-id sequences; returns the generated continuations in
+        submission order. The engine (and its compiled prefill/decode
+        steps) is cached on the model across calls."""
+        from .serving.engine import ServingEngine
+
+        eng = getattr(self, "_serving_engine", None)
+        if eng is None or eng.executor is not self.executor or \
+                (max_inflight and eng.n_slots != max_inflight) or \
+                (max_decode_len and
+                 eng.requested_max_decode_len != max_decode_len):
+            # eos_id stays per-call (threaded below), never baked into the
+            # cached engine — a prior call's EOS must not truncate later
+            # calls that didn't ask for one
+            eng = ServingEngine(self, n_slots=max_inflight,
+                                max_decode_len=max_decode_len)
+            self._serving_engine = eng
+        return eng.generate(prompts, max_new_tokens=max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            eos_id=eos_id, seed=seed)
 
     # ---- manual-loop API parity (model.cc:2415-2469) --------------------------
     def init_operators(self) -> None:

@@ -1,15 +1,22 @@
-"""Transformer encoder (the OSDI'22 BERT-proxy benchmark model) and MoE net.
+"""Transformer encoder (the OSDI'22 BERT-proxy benchmark model), a causal
+decoder variant for the serving engine, and the MoE net.
 
 Reference: examples/cpp/Transformer/transformer.cc:33-85 — 12 layers, hidden
 1024, 16 heads, seq 512; each layer = MHA + residual + 2-layer FFN (no
 layernorm in the reference's proxy — kept optional here);
 examples/cpp/mixture_of_experts/moe.cc — MNIST MLP with an MoE layer.
+``build_transformer_decoder`` is the autoregressive member of the family
+(ISSUE 6): the same block stack with CAUSAL self-attention, token/position
+embeddings and a per-token vocab head — what prefill/decode serving needs
+(the bidirectional encoder cannot be decoded incrementally).
 """
 from __future__ import annotations
 
 import dataclasses
 
-from ..ffconst import ActiMode
+import numpy as np
+
+from ..ffconst import ActiMode, DataType
 from ..model import FFModel
 
 
@@ -53,6 +60,38 @@ def build_transformer(ff: FFModel, cfg: TransformerConfig):
     pooled = ff.mean(t, dims=[1], name="pool")
     logits = ff.dense(pooled, 2, name="head")
     return x, ff.softmax(logits)
+
+
+def build_transformer_decoder(ff: FFModel, cfg: TransformerConfig,
+                              vocab_size: int = 256):
+    """Causal decoder-only variant of the proxy (ISSUE 6): token + learned
+    position embeddings, the same MHA/FFN block stack with ``causal=True``
+    attention, and an untied per-token vocab head. Returns
+    (input_ids tensor, logits tensor (b, s, vocab)) — the shape contract
+    the ServingEngine's prefill/decode split requires."""
+    ids = ff.create_tensor((cfg.batch_size, cfg.seq_len),
+                           dtype=DataType.DT_INT32, name="dec_input_ids")
+    tok = ff.embedding(ids, vocab_size, cfg.hidden, name="dec_wte")
+    pos_ids = ff.constant(
+        np.broadcast_to(np.arange(cfg.seq_len, dtype=np.int32),
+                        (cfg.batch_size, cfg.seq_len)), name="dec_pos_ids")
+    pos = ff.embedding(pos_ids, cfg.seq_len, cfg.hidden, name="dec_wpe")
+    t = ff.add(tok, pos)
+    for layer in range(cfg.num_layers):
+        attn = ff.multihead_attention(t, t, t, embed_dim=cfg.hidden,
+                                      num_heads=cfg.num_heads,
+                                      dropout=cfg.dropout, causal=True,
+                                      name=f"d{layer}_attn")
+        if cfg.use_layernorm:
+            attn = ff.layer_norm(ff.add(attn, t), axes=[2],
+                                 name=f"d{layer}_ln1")
+        h = ff.dense(attn, cfg.hidden, ActiMode.AC_MODE_RELU,
+                     name=f"d{layer}_fc1")
+        h = ff.dense(h, cfg.hidden, name=f"d{layer}_fc2")
+        t = ff.layer_norm(ff.add(h, attn), axes=[2], name=f"d{layer}_ln2") \
+            if cfg.use_layernorm else h
+    logits = ff.dense(t, vocab_size, use_bias=False, name="dec_head")
+    return ids, logits
 
 
 def build_moe_mlp(ff: FFModel, batch_size: int = 64, in_dim: int = 784,

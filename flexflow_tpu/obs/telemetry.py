@@ -100,6 +100,16 @@ class StepTelemetry:
         self.audit_runs: int = 0
         self.audit_failures: int = 0
         self.final_strategy: Optional[str] = None
+        # serving counters (ISSUE 6): filled by the ServingEngine after a
+        # serve() run — requests completed, tokens emitted, the bounded
+        # admission queue's high-water mark and the per-token latency
+        # percentiles, mirroring the resilience / strategy_safety blocks
+        self.requests_served: int = 0
+        self.tokens_generated: int = 0
+        self.queue_depth_hwm: int = 0
+        self.serving_p50_token_ms: Optional[float] = None
+        self.serving_p99_token_ms: Optional[float] = None
+        self.serving_tokens_per_s: Optional[float] = None
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -198,6 +208,19 @@ class StepTelemetry:
             if self.final_strategy is not None:
                 ss["final_strategy"] = self.final_strategy
             out["strategy_safety"] = ss
+        if self.requests_served or self.tokens_generated:
+            sv: Dict[str, Any] = {
+                "requests_served": self.requests_served,
+                "tokens_generated": self.tokens_generated,
+                "queue_depth_hwm": self.queue_depth_hwm,
+            }
+            if self.serving_tokens_per_s is not None:
+                sv["tokens_per_s"] = self.serving_tokens_per_s
+            if self.serving_p50_token_ms is not None:
+                sv["p50_token_ms"] = round(self.serving_p50_token_ms, 3)
+            if self.serving_p99_token_ms is not None:
+                sv["p99_token_ms"] = round(self.serving_p99_token_ms, 3)
+            out["serving"] = sv
         return out
 
     def write(self, path: str) -> str:

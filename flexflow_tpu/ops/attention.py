@@ -113,7 +113,13 @@ class MultiHeadAttentionOp(Op):
         dropout = self.attrs.get("dropout", 0.0)
         live_dropout = _resolve_live_dropout(dropout, ctx)
         seed = _dropout_seed(ctx.rng) if live_dropout else None
-        if seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
+        if ctx.serving is not None:
+            # serving engine prefill/decode (ISSUE 6): the KV ring buffer is
+            # the execution path, selected before any kernel routing —
+            # decode shapes (seq 1) must never reach flash/ring
+            out = _serving_attention(self.name, q, k, v, ctx.serving,
+                                     causal=causal)
+        elif seq_axis and ctx.mesh is not None and seq_axis in ctx.mesh.shape:
             if self.attrs.get("sequence_parallel_mode") == "alltoall":
                 from ..kernels.ulysses_attention import ulysses_attention
 
@@ -162,6 +168,72 @@ class MultiHeadAttentionOp(Op):
             "heads": {"weights": {"wq": 1, "wk": 1, "wv": 1, "wo": 0},
                       "reduces_output": True},
         }
+
+
+def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
+    """Prefill/decode attention over the serving KV ring buffer
+    (serving/kvcache.py; ISSUE 6). Numerics are kept IDENTICAL to
+    ``mha_core``'s einsum path — same scale, same ``-1e30`` additive mask,
+    same f32-accumulating einsums — so prefill+decode logits bitwise-match
+    the whole-sequence forward (tests/test_serving.py's equivalence gate):
+    masked lanes contribute exp(-1e30-max) == 0.0 exactly, and the ring
+    buffer's unwritten tail is zeros, so the wider reduction adds exact
+    zeros only.
+
+    * prefill: q/k/v carry the whole padded prompt; the causal core runs
+      unchanged and k/v land at position 0 of a fresh ``max_len`` buffer.
+    * decode: q/k/v carry ONE token per slot; k/v are written at
+      ``positions[slot]`` (per-slot dynamic_update_slice — static shapes,
+      no recompile) and q attends over the full buffer under the mask
+      ``key_pos <= position``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..serving.kvcache import write_token_kv
+
+    if not causal:
+        raise ValueError(
+            f"{name}: serving prefill/decode requires CAUSAL self-attention "
+            "(bidirectional attention cannot be decoded incrementally); "
+            "build the model with causal=True")
+    if sv.mode == "prefill":
+        b, h, L, hd = k.shape
+        kbuf = lax.dynamic_update_slice(
+            jnp.zeros((b, h, sv.max_len, hd), k.dtype), k, (0, 0, 0, 0))
+        vbuf = lax.dynamic_update_slice(
+            jnp.zeros((b, h, sv.max_len, v.shape[-1]), v.dtype), v,
+            (0, 0, 0, 0))
+        sv.cache_out[name] = (kbuf, vbuf)
+        return mha_core(q, k, v, causal=True)
+    kc, vc = sv.cache_in[name]
+    kc = write_token_kv(kc, k, sv.positions)
+    vc = write_token_kv(vc, v, sv.positions)
+    sv.cache_out[name] = (kc, vc)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    if sv.exact:
+        # bitwise mode: the 1-token q rides a full-extent score GEMM (its
+        # row is extracted afterwards) so the d-axis accumulation order
+        # matches the whole-sequence forward exactly; the fast path below
+        # lowers to a matvec that differs by ~1 ulp
+        qpad = write_token_kv(
+            jnp.zeros(kc.shape[:2] + (sv.max_len, q.shape[-1]), q.dtype),
+            q, sv.positions)
+        full = jnp.einsum("bhqd,bhkd->bhqk", qpad, kc,
+                          preferred_element_type=jnp.float32) * scale
+        logits = jnp.take_along_axis(
+            full, sv.positions[:, None, None, None], axis=2)
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                            preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(sv.max_len)
+    mask = kpos[None, None, None, :] <= sv.positions[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(vc.dtype)
 
 
 def _dropout_seed(rng):

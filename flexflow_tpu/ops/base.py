@@ -48,6 +48,14 @@ class OpContext:
     # across all node contexts.
     cache_in: Any = None
     cache_out: Any = None
+    # serving state (ISSUE 6, flexflow_tpu/serving): a
+    # ``serving.kvcache.ServingState`` when this forward is a prefill or
+    # decode step of the inference engine — ops with sequence state
+    # (causal attention's KV, the LSTM carry) read ``cache_in`` and
+    # publish into ``cache_out`` keyed by op name. None during training
+    # and plain whole-sequence inference, which is the only cost the
+    # existing paths pay.
+    serving: Any = None
 
 
 # registry: OperatorType -> Op subclass

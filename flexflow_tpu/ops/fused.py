@@ -99,7 +99,8 @@ class FusedOp(Op):
                      if ctx.rng is not None else None),
                 seq_length=ctx.seq_length, mesh=ctx.mesh,
                 profiling=ctx.profiling, aux_losses=ctx.aux_losses,
-                cache_in=ctx.cache_in, cache_out=ctx.cache_out)
+                cache_in=ctx.cache_in, cache_out=ctx.cache_out,
+                serving=ctx.serving)
             # sub-op named scope: xprof attributes work inside the region
             # to the member ops, not just the FusedOp node
             with jax.named_scope(sub.name):

@@ -49,7 +49,15 @@ class LSTMOp(Op):
         x = inputs[0]  # (b, s, d)
         b = x.shape[0]
         h = self.attrs["hidden_size"]
-        if len(inputs) > 1:
+        sv = ctx.serving  # serving engine prefill/decode (ISSUE 6)
+        if sv is not None and sv.mode == "decode" and sv.cache_in is not None \
+                and self.name in sv.cache_in:
+            # the LSTM's recurrent carry IS its decode state: resume from
+            # the cached [h, c] (which already folds any graph-provided
+            # initial_state through the prefill scan)
+            state = sv.cache_in[self.name]
+            h0, c0 = state[:, :h], state[:, h:]
+        elif len(inputs) > 1:
             h0, c0 = inputs[1][:, :h], inputs[1][:, h:]
         else:
             h0 = jnp.zeros((b, h), x.dtype)
@@ -69,12 +77,25 @@ class LSTMOp(Op):
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             c_n = sigmoid(f) * c_t + sigmoid(i) * jnp.tanh(g)
             h_n = sigmoid(o) * jnp.tanh(c_n)
-            return (h_n, c_n), h_n
+            return (h_n, c_n), (h_n, c_n)
 
-        (h_f, c_f), ys = lax.scan(step, (h0, c0),
-                                  jnp.swapaxes(xproj, 0, 1))
+        (h_f, c_f), (ys, cs) = lax.scan(step, (h0, c0),
+                                        jnp.swapaxes(xproj, 0, 1))
         outputs = jnp.swapaxes(ys, 0, 1)  # (b, s, h)
         final_state = jnp.concatenate([h_f, c_f], axis=-1)
+        if sv is not None:
+            if sv.mode == "prefill" and sv.lengths is not None:
+                # right-padded prompt: the carry to hand decode is the state
+                # at the LAST REAL token (length-1), not at the padded tail
+                # the scan kept marching through
+                states = jnp.concatenate(
+                    [jnp.swapaxes(ys, 0, 1), jnp.swapaxes(cs, 0, 1)],
+                    axis=-1)  # (b, s, 2h)
+                idx = jnp.clip(sv.lengths - 1, 0, states.shape[1] - 1)
+                sv.cache_out[self.name] = jnp.take_along_axis(
+                    states, idx[:, None, None], axis=1)[:, 0]
+            else:
+                sv.cache_out[self.name] = final_state
         return [outputs, final_state]
 
     def flops(self, input_shapes, output_shapes):

@@ -270,9 +270,21 @@ class PipelineTrainer:
             self._ph_guids.append(ph_guids)
             out_refs = spec.outputs
 
-            def make_forward(sub=sub, ph_guids=ph_guids, out_refs=out_refs):
+            # batch-shaped constants (the gpt2-style position-id pattern,
+            # serving/kvcache.is_position_constant) are baked at the FULL
+            # batch; a microbatched stage must slice them to its rows or
+            # the first elementwise consumer fails to broadcast
+            from ..serving.kvcache import is_position_constant
+
+            mb_const = {n.guid for n in sub.topo_order()
+                        if n.op.op_type == OperatorType.OP_CONSTANT
+                        and is_position_constant(n.op.attrs.get("value"))}
+
+            def make_forward(sub=sub, ph_guids=ph_guids, out_refs=out_refs,
+                             mb_const=mb_const):
                 def f(params, ins, rng):
                     ctx = OpContext(training=True, rng=rng, aux_losses=[])
+                    mb = ins[0].shape[0] if ins else None
                     values: Dict[int, List[Any]] = {}
                     for g, x in zip(ph_guids, ins):
                         values[g] = [x]
@@ -285,8 +297,12 @@ class PipelineTrainer:
                             rng=(jax.random.fold_in(ctx.rng, node.guid)
                                  if ctx.rng is not None else None),
                             aux_losses=ctx.aux_losses)
-                        values[node.guid] = node.op.forward(
+                        outs = node.op.forward(
                             params.get(node.name, {}), inputs, node_ctx)
+                        if node.guid in mb_const and mb is not None and \
+                                outs[0].shape[0] > mb:
+                            outs = [outs[0][:mb]] + list(outs[1:])
+                        values[node.guid] = outs
                     outs = tuple(values[g][i] for g, i in out_refs)
                     aux = sum(ctx.aux_losses) if ctx.aux_losses else 0.0
                     return outs, aux

@@ -1613,6 +1613,28 @@ def _load_xfers(config):
     return xfers
 
 
+def search_all(pcg: PCG, config, n_dev: int, objective: str = "training",
+               **kwargs):
+    """Objective-dispatching search façade (ISSUE 6): the training
+    objective runs the classic Unity step-time search (``unity_search``);
+    ``objective="serving"`` optimizes latency-bounded throughput for the
+    DECODE graph instead — tokens/sec subject to simulated p99 <=
+    ``--slo-p99-ms`` — via ``serving.search.serving_search`` (which
+    returns a ServingPlan rather than a Strategy; the plan's
+    ``to_strategy`` materializes executor shardings). Both objectives
+    share the Simulator's delta-cost caches when a warm ``sim=`` is
+    passed."""
+    if objective == "serving":
+        from ..serving.search import serving_search
+
+        return serving_search(pcg, config, n_dev, **kwargs)
+    if objective != "training":
+        raise ValueError(
+            f"unknown search objective {objective!r}: "
+            "expected 'training' or 'serving'")
+    return unity_search(pcg, config, n_dev, **kwargs)
+
+
 # ---------------------------------------------------------------- legacy MCMC
 def mcmc_optimize(pcg: PCG, config, n_dev: int,
                   machine: Optional[TPUMachineModel] = None,

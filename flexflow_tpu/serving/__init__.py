@@ -1,0 +1,14 @@
+"""flexflow_tpu.serving: the inference engine (ISSUE 6, docs/serving.md).
+
+Prefill/decode split with a first-class KV-cache pytree, Orca-style
+continuous batching over a fixed decode-slot pool, and a Unity serving
+objective (latency-bounded throughput) next to the training step-time
+search. The reference snapshot shipped only an incomplete Triton serving
+prototype; this subsystem is that story finished in JAX.
+"""
+from .kvcache import DecodeState, ServingState  # noqa: F401
+from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
+                        Request, bucket_for, default_buckets)
+from .engine import ServingEngine, ServingStats  # noqa: F401
+from .search import (ServingCandidate, ServingPlan,  # noqa: F401
+                     ServingSearchError, serving_search)

@@ -1,0 +1,491 @@
+"""ServingEngine: the inference engine over a compiled FFModel.
+
+This graduates ``model.predict``'s per-batch forward loop into a real
+serving path (ISSUE 6; the reference snapshot's only inference artifact is
+an *incomplete* Triton prototype, triton/README.md): prefill/decode split
+with a first-class KV-cache pytree (serving/kvcache.py), Orca-style
+continuous batching over a fixed slot pool (serving/scheduler.py), greedy
+and temperature/top-k sampling (the Pallas top-k kernel where eligible),
+and obs wiring (prefill/decode/schedule tracer events + the StepTelemetry
+``serving`` block).
+
+Static shapes everywhere: ONE decode compile serves every request mix
+(asserted via the jit cache size — ``decode_compiles``), and prefill
+compiles once per length bucket. The decode-state layout on a real mesh is
+a *searched* axis: ``serving.search.serving_search`` prices replica- vs
+tensor-parallel decode (KV sharded over heads) with the simulator's memory
+accounting, and ``elastic_replan`` re-runs that search mid-serve when the
+device pool changes — the in-flight DecodeState survives the hop, so
+generation continues bit-identically (PR 4/5 carry-over: re-search and
+keep serving).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ffconst import OperatorType
+from .kvcache import DecodeState, update_slot_entry
+from .scheduler import ContinuousBatchScheduler, Request, default_buckets
+
+
+@dataclasses.dataclass
+class ServingStats:
+    """Host-side counters of one serve() run — the bench serving_leg and
+    the StepTelemetry ``serving`` block read these."""
+
+    requests_served: int = 0
+    tokens_generated: int = 0
+    prefills: int = 0
+    decode_steps: int = 0
+    queue_depth_hwm: int = 0
+    wall_s: float = 0.0
+    # per-token latency distribution: decode tokens carry their step wall,
+    # first tokens their prefill wall
+    token_walls_s: List[float] = dataclasses.field(default_factory=list)
+
+    def tokens_per_s(self) -> float:
+        return self.tokens_generated / self.wall_s if self.wall_s > 0 else 0.0
+
+    def batch_occupancy(self, n_slots: int) -> float:
+        """Fraction of decode-slot-steps that produced a kept token — the
+        continuous-batching utilization headline (1.0 = every slot busy
+        every step). First tokens come from prefill, not a decode slot,
+        so they stay out of the numerator."""
+        denom = self.decode_steps * n_slots
+        return max(self.tokens_generated - self.prefills, 0) / denom \
+            if denom else 0.0
+
+    def p50_token_ms(self) -> Optional[float]:
+        if not self.token_walls_s:
+            return None
+        return float(np.percentile(self.token_walls_s, 50) * 1e3)
+
+    def p99_token_ms(self) -> Optional[float]:
+        if not self.token_walls_s:
+            return None
+        return float(np.percentile(self.token_walls_s, 99) * 1e3)
+
+    def summary(self) -> Dict[str, Any]:
+        out = {
+            "requests_served": self.requests_served,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "queue_depth_hwm": self.queue_depth_hwm,
+            "wall_s": round(self.wall_s, 4),
+            "tokens_per_s": round(self.tokens_per_s(), 2),
+        }
+        p50, p99 = self.p50_token_ms(), self.p99_token_ms()
+        if p50 is not None:
+            out["p50_token_ms"] = round(p50, 3)
+            out["p99_token_ms"] = round(p99, 3)
+        return out
+
+
+class ServingEngine:
+    """Inference engine over a compiled autoregressive FFModel.
+
+    Requirements on the graph (validated at construction): causal
+    self-attention (``multihead_attention(..., causal=True)``) and/or LSTM
+    recurrence as the only sequence-stateful ops, a per-token final output
+    ``(batch, seq, vocab)``, and — for :meth:`generate` — a single integer
+    token input. models/gpt2.py and models/transformer.py's
+    ``build_transformer_decoder`` qualify; bidirectional encoders do not
+    (incremental decode is undefined for them, and the engine says so).
+    """
+
+    def __init__(self, model, n_slots: Optional[int] = None,
+                 max_decode_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 64,
+                 eos_id: Optional[int] = None,
+                 exact_decode: bool = False):
+        assert model.executor is not None, "call model.compile() first"
+        self.model = model
+        self.executor = model.executor
+        cfg = model.config
+        self.n_slots = int(n_slots or getattr(cfg, "max_inflight", 8))
+        self.max_decode_len = int(max_decode_len or
+                                  getattr(cfg, "max_decode_len", 128))
+        # pre-clamp value, so FFModel.generate's engine-cache check can
+        # compare against what the caller ASKED for
+        self.requested_max_decode_len = self.max_decode_len
+        self.max_queue = max_queue
+        self.eos_id = eos_id
+        # bitwise-vs-full-forward decode numerics (ServingState.exact) —
+        # the verification mode; default is the fast matvec score path
+        self.exact_decode = bool(exact_decode)
+        self._validate_graph()  # may clamp max_decode_len (position table)
+        self.buckets = tuple(buckets) if buckets else \
+            default_buckets(self.max_decode_len)
+        self.state: Optional[DecodeState] = None
+        self._last_tokens = None  # (n_slots, 1) device int32
+        self._write_slot_fn = None
+        self._samplers: Dict = {}
+        self.stats = ServingStats()
+        self.plan = None  # ServingPlan from the last (re)search, if any
+        self._search_sim = None  # warm Simulator for elastic re-search
+
+    # ------------------------------------------------------------ validation
+    def _validate_graph(self) -> None:
+        from .kvcache import is_position_constant
+
+        pcg = self.executor.pcg
+        final = pcg.nodes[self.executor.final_guid]
+        out = final.out_shapes[self.executor.final_out_idx]
+        if len(out) != 3:
+            raise ValueError(
+                f"serving needs a per-token final output (batch, seq, "
+                f"vocab); {final.name} produces {out} — pooled/classifier "
+                "heads cannot be decoded token by token")
+        pos_guids = set(self.executor._position_const_guids())
+        for node in pcg.compute_nodes():
+            ot = node.op.op_type
+            if ot == OperatorType.OP_SDPA:
+                raise NotImplementedError(
+                    f"{node.name}: OP_SDPA graphs (torch frontend) have no "
+                    "serving decode path yet; build with "
+                    "multihead_attention(causal=True)")
+            if ot == OperatorType.OP_FUSED:
+                # a fused region hides its members from the per-node
+                # serving machinery: stateful sub-ops would decode without
+                # history and a fused position constant escapes the
+                # override hook — refuse LOUDLY rather than generate
+                # garbage (plain elementwise fusions are fine)
+                for sub in node.op.sub_ops:
+                    if sub.op_type in (
+                            OperatorType.OP_MULTIHEAD_ATTENTION,
+                            OperatorType.OP_LSTM) or (
+                            sub.op_type == OperatorType.OP_CONSTANT
+                            and is_position_constant(
+                                sub.attrs.get("value"))):
+                        raise NotImplementedError(
+                            f"{node.name}: fusion folded the stateful/"
+                            f"position op {sub.name} into a region the "
+                            "serving engine cannot thread decode state "
+                            "through; recompile without --fusion to serve")
+            if ot == OperatorType.OP_MULTIHEAD_ATTENTION:
+                if not node.op.attrs.get("causal", False):
+                    raise ValueError(
+                        f"{node.name}: serving requires causal=True "
+                        "attention (bidirectional attention cannot be "
+                        "decoded incrementally)")
+                if len({g for g, _ in node.inputs}) != 1:
+                    raise ValueError(
+                        f"{node.name}: serving decode supports "
+                        "self-attention only (q, k, v from one producer)")
+            if ot == OperatorType.OP_EMBEDDING and any(
+                    g in pos_guids for g, _ in node.inputs):
+                # the position table bounds decodable length: positions
+                # beyond it would CLAMP under jit (jnp.take) and silently
+                # reuse the last row's embedding — clamp the ring LOUDLY
+                # to the table instead
+                entries = int(node.op.attrs.get("num_entries", 0))
+                if entries and entries < self.max_decode_len:
+                    import warnings
+
+                    warnings.warn(
+                        f"{node.name}: position table has {entries} "
+                        f"entries < max_decode_len {self.max_decode_len}; "
+                        f"clamping the decode ring to {entries} (build "
+                        "the model with a longer seq_len to serve longer "
+                        "sequences)")
+                    self.max_decode_len = entries
+
+    def _token_input_check(self) -> None:
+        ins = self.executor.pcg.input_nodes()
+        from ..ffconst import DataType
+
+        if len(ins) != 1 or ins[0].op.attrs.get("dtype") not in (
+                DataType.DT_INT32, DataType.DT_INT64):
+            raise ValueError(
+                "generate() needs a single integer token input; this graph "
+                f"has {len(ins)} input(s) — drive prefill/decode steps "
+                "directly (executor.make_prefill_step/make_decode_step) "
+                "for custom input schemes")
+
+    # -------------------------------------------------------------- obs hooks
+    def _tracer(self):
+        return self.model._obs_tracer()
+
+    @property
+    def decode_compiles(self) -> Optional[int]:
+        """Entries in the decode step's jit cache — the recompile-free
+        contract is exactly ``== 1`` after warmup (asserted in tier-1)."""
+        fn = self.executor._serving_jits.get(
+            ("decode", self.max_decode_len, self.exact_decode))
+        if fn is None:
+            return None
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------ device fns
+    def _decode_fn(self):
+        return self.executor.make_decode_step(self.max_decode_len,
+                                              exact=self.exact_decode)
+
+    def _prefill_fn(self, bucket: int):
+        return self.executor.make_prefill_step(bucket, self.max_decode_len)
+
+    def _write_slot(self, cache, slot: int, length: int, token) -> None:
+        """Insert one prefilled request into the decode batch: cache rows,
+        length cursor and the pending first token — one jitted scatter,
+        slot/length/token traced (no per-slot recompiles)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._write_slot_fn is None:
+            def write(state, last, cache, slot, length, token):
+                caches = {
+                    name: update_slot_entry(state.caches[name],
+                                            cache[name], slot)
+                    for name in state.caches}
+                lengths = state.lengths.at[slot].set(length)
+                last = last.at[slot, 0].set(token)
+                return DecodeState(caches=caches, lengths=lengths), last
+
+            self._write_slot_fn = jax.jit(write, donate_argnums=(0, 1))
+        self.state, self._last_tokens = self._write_slot_fn(
+            self.state, self._last_tokens, cache,
+            jnp.int32(slot), jnp.int32(length), jnp.int32(token))
+
+    def _ensure_state(self, prefill_cache) -> None:
+        """Allocate the slot-pool DecodeState lazily from the first
+        prefill's cache structure (zeros; every slot's rows are fully
+        overwritten by its admission prefill before any read)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.state is not None:
+            return
+        n = self.n_slots
+        caches = jax.tree.map(
+            lambda leaf: jnp.zeros((n,) + leaf.shape[1:], leaf.dtype),
+            prefill_cache)
+        self.state = DecodeState(caches=caches,
+                                 lengths=jnp.zeros((n,), jnp.int32))
+        self._last_tokens = jnp.zeros((n, 1), jnp.int32)
+
+    def _sampler(self, temperature: float, top_k: int):
+        """Jitted ``(logits (S, V), base_rng, tag_counts (S, 2) int32) ->
+        tokens (S,)`` — one row per slot, each row drawing from its own
+        stream ``fold_in(fold_in(base, tag), count)``. The folds happen
+        IN-JIT so the decode hot loop dispatches one fused program, not
+        2·slots host-side fold_in calls per token. Greedy when
+        temperature <= 0; otherwise top-k filtered categorical at
+        ``temperature`` — through the Pallas row top-k kernel when the
+        shape qualifies (kernels/topk.py), ``lax.top_k`` otherwise."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = temperature <= 0.0
+        key = ("greedy",) if greedy else ("sample", float(temperature),
+                                          int(top_k))
+        fn = self._samplers.get(key)
+        if fn is not None:
+            return fn
+        if greedy:
+            def sample(logits, base_rng, tag_counts):
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            temp = float(temperature)
+            k = int(top_k)
+
+            def row_rng(base_rng, tc):
+                return jax.random.fold_in(
+                    jax.random.fold_in(base_rng, tc[0]), tc[1])
+
+            def sample(logits, base_rng, tag_counts):
+                rngs = jax.vmap(lambda tc: row_rng(base_rng, tc))(
+                    tag_counts)
+                if k > 0:
+                    from ..kernels.topk import (pallas_topk,
+                                                should_use_pallas_topk)
+
+                    if should_use_pallas_topk(logits, k, opt_in=True):
+                        vals, idx = pallas_topk(logits, k)
+                    else:
+                        vals, idx = jax.lax.top_k(logits, k)
+                    choice = jax.vmap(
+                        lambda v, r: jax.random.categorical(r, v / temp))(
+                            vals, rngs)
+                    return jnp.take_along_axis(
+                        idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+                return jax.vmap(
+                    lambda lg, r: jax.random.categorical(r, lg / temp))(
+                        logits, rngs).astype(jnp.int32)
+
+        fn = jax.jit(sample)
+        self._samplers[key] = fn
+        return fn
+
+    # ------------------------------------------------------------- main loop
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, eos_id: Optional[int] = None,
+                 seed: int = 0) -> List[List[int]]:
+        """Generate continuations for ``prompts`` (token-id sequences)
+        through the continuous-batching loop; returns the generated token
+        lists in submission order. Deterministic for a given (prompts,
+        sampling params, seed) regardless of slot timing."""
+        self._token_input_check()
+        sched = ContinuousBatchScheduler(
+            n_slots=self.n_slots, max_queue=max(len(prompts),
+                                                self.max_queue),
+            buckets=self.buckets, max_len=self.max_decode_len)
+        reqs = []
+        for i, p in enumerate(prompts):
+            r = Request(prompt=np.asarray(p, dtype=np.int32),
+                        max_new_tokens=max_new_tokens,
+                        eos_id=self.eos_id if eos_id is None else eos_id,
+                        rng_tag=i)
+            sched.submit(r)
+            reqs.append(r)
+        self.serve(sched, temperature=temperature, top_k=top_k, seed=seed)
+        return [list(r.generated) for r in reqs]
+
+    def serve(self, sched: ContinuousBatchScheduler,
+              temperature: float = 0.0, top_k: int = 0,
+              seed: int = 0) -> ServingStats:
+        """Drive the scheduler until queue and slots drain. One decode
+        step advances EVERY live slot one token (iteration-level
+        batching); prefills are interleaved the moment a slot frees."""
+        import jax
+        import jax.numpy as jnp
+
+        tracer = self._tracer()
+        params = self.model.params
+        sampler = self._sampler(temperature, top_k)
+        stats = self.stats = ServingStats()
+        base_rng = jax.random.PRNGKey(seed)
+        step_no = 0
+        t0 = time.perf_counter()
+        while True:
+            action = sched.next_action()
+            if action is None:
+                break
+            if action[0] == "prefill":
+                _, req, slot, bucket = action
+                t_p = time.perf_counter()
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :req.prompt_len] = req.prompt
+                _logits, last, cache = self._prefill_fn(bucket)(
+                    params, [jnp.asarray(ids)],
+                    jnp.asarray([req.prompt_len], jnp.int32))
+                self._ensure_state(cache)
+                # per-request rng: deterministic under co-scheduling — the
+                # stream depends on the request's submission tag, not slot
+                # timing (folded in-jit from (tag, 0))
+                tag = req.rng_tag if req.rng_tag is not None else req.rid
+                tok = int(jax.device_get(
+                    sampler(last, base_rng,
+                            np.asarray([[tag, 0]], np.int32))[0]))
+                wall = time.perf_counter() - t_p
+                stats.prefills += 1
+                stats.token_walls_s.append(wall)
+                stats.tokens_generated += 1
+                req.first_token_step = step_no
+                if tracer.enabled:
+                    tracer.complete("prefill", wall, rid=req.rid,
+                                    bucket=bucket, slot=slot,
+                                    prompt_len=req.prompt_len)
+                if not sched.commit_token(slot, tok):
+                    self._write_slot(cache, slot, req.prompt_len, tok)
+                continue
+            # decode: one token for every live slot. Sampling covers ALL
+            # slots (free ones with a dummy rng, their draws discarded) so
+            # the sampler's shapes are as static as the decode step's —
+            # the whole loop compiles a bounded, occupancy-independent set
+            # of programs.
+            _, live = action
+            t_d = time.perf_counter()
+            decode = self._decode_fn()
+            logits, self.state = decode(params, [self._last_tokens],
+                                        self.state)
+            live_map = dict(live)
+            # per-slot rng streams depend on (submission tag, tokens
+            # emitted), never on slot index or batch composition — built
+            # as ONE host numpy array, folded in-jit by the sampler
+            tag_counts = np.zeros((self.n_slots, 2), np.int32)
+            for s, r in live_map.items():
+                tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
+                    else r.rid
+                tag_counts[s, 1] = len(r.generated)
+            toks = sampler(logits, base_rng, tag_counts)
+            self._last_tokens = toks[:, None]
+            toks_host = np.asarray(jax.device_get(toks))
+            wall = time.perf_counter() - t_d
+            stats.decode_steps += 1
+            step_no += 1
+            for slot, req in live:
+                stats.tokens_generated += 1
+                stats.token_walls_s.append(wall)
+                sched.commit_token(slot, int(toks_host[slot]))
+            if tracer.enabled:
+                tracer.complete("decode_step", wall, step=step_no,
+                                live_slots=len(live))
+        stats.wall_s = time.perf_counter() - t0
+        stats.requests_served = len(sched.finished)
+        stats.queue_depth_hwm = sched.queue_depth_hwm
+        self._merge_telemetry(sched, stats)
+        if tracer.enabled and self.model.config.trace_file:
+            tracer.write(self.model.config.trace_file)
+        return stats
+
+    def _merge_telemetry(self, sched, stats: ServingStats) -> None:
+        """Publish the run into a StepTelemetry ``serving`` block (mirrors
+        the resilience / strategy_safety blocks) when a sink wants one."""
+        tracer = self._tracer()
+        tel = self.model._make_telemetry(tracer, batch_size=self.n_slots,
+                                         phase="serving")
+        self.model._telemetry = tel or getattr(self.model, "_telemetry",
+                                               None)
+        if tel is None:
+            return
+        for w in stats.token_walls_s:
+            tel.record_step(w)
+        tel.requests_served = stats.requests_served
+        tel.tokens_generated = stats.tokens_generated
+        tel.queue_depth_hwm = stats.queue_depth_hwm
+        tel.serving_p50_token_ms = stats.p50_token_ms()
+        tel.serving_p99_token_ms = stats.p99_token_ms()
+        tel.serving_tokens_per_s = round(stats.tokens_per_s(), 2)
+        tel.finalize()
+        if self.model.config.telemetry_file:
+            tel.write(self.model.config.telemetry_file)
+
+    # ---------------------------------------------------------------- elastic
+    def elastic_replan(self, n_dev: int):
+        """Mid-serve re-search (PR 4/5 carry-over): a replica that lost
+        chips re-runs the serving-objective search on the surviving device
+        count — reusing the warm delta-cost Simulator. The searched plan
+        is RECORDED (``self.plan``; ``plan.to_strategy`` materializes
+        executor shardings) — applying it to a live multi-chip mesh
+        (reshard weights + DecodeState onto the new layout) is the
+        follow-on; what this models today is the migration's control path:
+        the serving jits are deliberately dropped and recompiled, and the
+        in-flight DecodeState must survive that hop untouched, so
+        generation resumes exactly where it stopped (tier-1 asserts
+        bit-identical continuations across a replan)."""
+        from .search import serving_search
+
+        plan = serving_search(self.executor.pcg, self.model.config, n_dev,
+                              sim=self._search_sim)
+        self._search_sim = plan.sim
+        self.plan = plan
+        # drop and rebuild the serving jits — the migration recompile the
+        # bit-identity contract is tested against; samplers and the slot
+        # writer are state-shape-stable and survive
+        self.executor._serving_jits = {}
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("serving_replan", n_dev=n_dev,
+                         mesh=list(plan.mesh_shape),
+                         tokens_per_s=round(plan.sim_tokens_per_s, 1))
+        return plan

@@ -1,0 +1,144 @@
+"""First-class KV-cache / decode-state pytrees for the serving engine.
+
+The reference snapshot's only inference artifact is an incomplete Triton
+prototype (triton/README.md); its training-side ``CacheOp`` (src/ops/
+cache.cc) threads one cached tensor per op through the step. This module
+generalizes that pattern into the serving engine's decode state (ISSUE 6):
+
+* ``ServingState`` — the per-forward context ops see (``OpContext.serving``):
+  mode ("prefill" | "decode"), the static ring-buffer capacity, per-slot
+  write positions, and the cache_in/cache_out dicts keyed by op name.
+  Stateful ops (causal ``MultiHeadAttentionOp``, ``LSTMOp``) read and
+  extend it; everything else is oblivious.
+
+* ``DecodeState`` — the jit-carried pytree between decode steps: one cache
+  entry per stateful node plus the per-slot ``lengths`` cursor. Registered
+  as a pytree node so it flows through ``jax.jit`` donation like any other
+  train-state argument.
+
+Static shapes are the design rule (no per-token recompiles): the KV cache
+is a ring buffer of capacity ``max_len`` per slot — prefill writes the
+prompt at position 0, each decode step writes ONE token at
+``lengths[slot]`` via a per-slot dynamic_update_slice, and attention masks
+key positions ``> position``. Pad garbage beyond a prompt's true length is
+never read: the write cursor overwrites it before the mask ever exposes it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ServingState:
+    """Per-forward serving context threaded as ``OpContext.serving``.
+
+    mode:      "prefill" (whole padded prompt) or "decode" (one token/slot)
+    max_len:   ring-buffer capacity — the static sequence axis of every
+               cache entry (``--max-decode-len``)
+    positions: (batch,) int32 — the first position this call writes
+               (zeros for prefill; ``DecodeState.lengths`` for decode)
+    lengths:   (batch,) int32 true prompt lengths (prefill only — the LSTM
+               carry must be read at position length-1, not at the padded
+               tail; attention needs no lengths, its causal mask + the
+               decode-side position mask cover padding)
+    cache_in:  {node_name: state pytree} consumed by decode
+    cache_out: {node_name: state pytree} every stateful op fills
+    exact:     decode-numerics mode: True routes the attention score
+               through a full-extent GEMM (the new token's q padded to
+               max_len rows) so decode logits are BITWISE-identical to the
+               whole-sequence forward — XLA lowers a 1-row score product
+               as a matvec whose d-axis accumulation order differs from
+               the GEMM's by ~1 ulp otherwise. Default False (the fast
+               matvec); the equivalence tests and audits flip it on.
+    """
+
+    mode: str
+    max_len: int
+    positions: Any
+    lengths: Any = None
+    cache_in: Optional[Dict[str, Any]] = None
+    cache_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    exact: bool = False
+
+
+@dataclasses.dataclass
+class DecodeState:
+    """The decode loop's carried state: {node_name: cache pytree} plus the
+    per-slot length cursor. A pytree node — ``jax.jit`` donates and returns
+    it whole, so the ring buffers update in place on device (the decode
+    loop never copies the cache host-side)."""
+
+    caches: Dict[str, Any]
+    lengths: Any  # (n_slots,) int32
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.lengths.shape[0])
+
+
+def _decode_state_flatten(s: "DecodeState"):
+    names = tuple(sorted(s.caches))
+    return ([s.caches[k] for k in names] + [s.lengths]), names
+
+
+def _decode_state_unflatten(names, children):
+    return DecodeState(caches=dict(zip(names, children[:-1])),
+                       lengths=children[-1])
+
+
+def _register_pytree() -> None:
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        DecodeState, _decode_state_flatten, _decode_state_unflatten)
+
+
+_register_pytree()
+
+
+# ---------------------------------------------------------------- helpers
+def is_position_constant(value) -> bool:
+    """Detect the position-id constant pattern the autoregressive builders
+    bake in (models/gpt2.py: ``broadcast(arange(seq_len), (b, s))``): an
+    integer 2-D constant whose every row is ``arange(seq)``. Serving must
+    regenerate it per phase — prefill gets ``arange(bucket_len)``, decode
+    gets each slot's current position — because the baked value is shaped
+    for the training batch/sequence."""
+    v = np.asarray(value)
+    if v.ndim != 2 or not np.issubdtype(v.dtype, np.integer):
+        return False
+    if v.shape[1] < 1:
+        return False
+    return bool(np.all(v == np.arange(v.shape[1], dtype=v.dtype)[None, :]))
+
+
+def update_slot_entry(cache_entry, prefill_entry, slot):
+    """Insert one prefilled request's cache rows (leading dim 1) into the
+    decode batch's entry (leading dim n_slots) at ``slot`` — a traced
+    index, so slot choice never recompiles."""
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    def ins(dst, src):
+        start = (slot,) + (0,) * (dst.ndim - 1)
+        return lax.dynamic_update_slice(dst, src.astype(dst.dtype),
+                                        tuple(jnp.asarray(s) for s in start))
+
+    return jax.tree.map(ins, cache_entry, prefill_entry)
+
+
+def write_token_kv(buf, new, positions):
+    """Scatter one token's k or v (b, h, 1, hd) into the ring buffer
+    (b, h, max_len, hd) at per-slot ``positions`` — vmapped
+    dynamic_update_slice, exact (no arithmetic on the stored values)."""
+    import jax
+    import jax.lax as lax
+
+    def one(dst, src, p):  # (h, L, hd), (h, 1, hd), scalar
+        return lax.dynamic_update_slice(dst, src, (0, p, 0))
+
+    return jax.vmap(one)(buf, new, positions)

@@ -1,0 +1,185 @@
+"""Continuous (iteration-level) batching scheduler for the serving engine.
+
+Orca-style (OSDI'22) iteration-level scheduling over a fixed pool of decode
+slots: new requests are admitted into the in-flight decode batch the moment
+a slot frees up (no wait for the whole batch to drain), prompts are
+length-bucketed so prefill compiles once per bucket instead of once per
+prompt length (padding-free in the compile-cache sense: a handful of
+static shapes cover every length), finished slots are recycled on
+EOS/max-tokens, and admission backpressure is a bounded queue — ``submit``
+refuses instead of letting an unbounded backlog eat host memory.
+
+The scheduler is PURE host-side bookkeeping — deterministic by
+construction (same submission order + same engine -> same token streams),
+which is what the cross-request isolation tests key on. Device work
+(prefill/decode/slot writes) lives in serving/engine.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_req_counter = itertools.count(1)
+
+
+class QueueFullError(RuntimeError):
+    """Admission refused: the bounded submit queue is at capacity
+    (``max_queue``). Callers should retry later or shed load — this is the
+    backpressure signal, not an internal failure."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``prompt`` is a 1-D int token array;
+    ``generated`` fills as decode steps commit tokens."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rid: int = dataclasses.field(default_factory=lambda: next(_req_counter))
+    eos_id: Optional[int] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    finish_reason: Optional[str] = None  # "eos" | "length"
+    # serving telemetry (per-request): set by the engine
+    submit_step: int = 0
+    first_token_step: Optional[int] = None
+    # sampling-stream tag: the engine keys each request's rng fold on this
+    # (submission order) rather than the process-global ``rid`` counter, so
+    # the same (prompts, seed) reproduces the same draws run after run
+    rng_tag: Optional[int] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+
+def default_buckets(max_prompt_len: int, min_bucket: int = 16
+                    ) -> Tuple[int, ...]:
+    """Geometric prefill buckets: powers of two from ``min_bucket``,
+    capped by ``max_prompt_len`` itself as the last bucket (a bucket wider
+    than the decode ring would overflow the KV buffers) — each prompt pads
+    to the smallest covering bucket, so the prefill jit cache holds at
+    most log2(max/min)+1 entries."""
+    buckets = []
+    b = min(max(int(min_bucket), 1), max_prompt_len)
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(min(b, max_prompt_len))
+    return tuple(buckets)
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"prompt length {length} exceeds the largest prefill bucket "
+        f"{buckets[-1]} (raise --max-decode-len / the engine's buckets)")
+
+
+class ContinuousBatchScheduler:
+    """Slot allocator + admission queue for iteration-level batching.
+
+    The engine drives it in a loop:
+
+        while scheduler.active or scheduler.queued:
+            action = scheduler.next_action()
+            if action[0] == "prefill": ...engine prefills into a slot...
+            else:                      ...engine runs one decode step...
+
+    Invariants (tested): a slot serves exactly one request at a time; a
+    freed slot's cache rows are fully overwritten by the next prefill
+    before any decode reads them (no cross-request leakage); admission
+    order is FIFO; the whole schedule is a deterministic function of the
+    submission sequence.
+    """
+
+    def __init__(self, n_slots: int, max_queue: int = 64,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_len: int = 128):
+        assert n_slots >= 1, "need at least one decode slot"
+        self.n_slots = n_slots
+        self.max_queue = max_queue
+        self.max_len = max_len
+        self.buckets = tuple(buckets) if buckets else \
+            default_buckets(max_len)
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * n_slots
+        self._free: Deque[int] = deque(range(n_slots))
+        self.finished: List[Request] = []
+        # counters for the obs serving block / bench occupancy
+        self.queue_depth_hwm = 0
+        self.admitted = 0
+        self.recycled = 0
+
+    # ------------------------------------------------------------ admission
+    @property
+    def queued(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def submit(self, req: Request) -> None:
+        """FIFO admission with bounded-queue backpressure."""
+        if len(self.queue) >= self.max_queue:
+            raise QueueFullError(
+                f"serving queue full ({self.max_queue} waiting); "
+                "retry later or raise --max-inflight/max_queue")
+        if req.prompt_len + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {req.prompt_len} + "
+                f"max_new_tokens {req.max_new_tokens} exceeds the decode "
+                f"ring capacity {self.max_len} (--max-decode-len)")
+        # fail HERE, not after next_action() already claimed a slot: a
+        # prompt no bucket covers must never corrupt the slot pool
+        bucket_for(req.prompt_len, self.buckets)
+        self.queue.append(req)
+        self.queue_depth_hwm = max(self.queue_depth_hwm, len(self.queue))
+
+    # ------------------------------------------------------------ scheduling
+    def next_action(self):
+        """("prefill", request, slot, bucket_len) when a request can be
+        admitted into a free slot — prefill takes priority so freed
+        capacity never idles while work queues; else ("decode",
+        [(slot, request), ...]) over the in-flight slots; else None."""
+        if self.queue and self._free:
+            req = self.queue.popleft()
+            slot = self._free.popleft()
+            self.slots[slot] = req
+            self.admitted += 1
+            return ("prefill", req, slot,
+                    bucket_for(req.prompt_len, self.buckets))
+        live = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if live:
+            return ("decode", live)
+        return None
+
+    def commit_token(self, slot: int, token: int) -> bool:
+        """Record one generated token for the request in ``slot``; returns
+        True when the request finished (EOS or length) and the slot was
+        recycled."""
+        req = self.slots[slot]
+        assert req is not None, f"decode token for empty slot {slot}"
+        req.generated.append(int(token))
+        if req.eos_id is not None and int(token) == int(req.eos_id):
+            return self._finish(slot, "eos")
+        if len(req.generated) >= req.max_new_tokens:
+            return self._finish(slot, "length")
+        return False
+
+    def _finish(self, slot: int, reason: str) -> bool:
+        req = self.slots[slot]
+        req.done = True
+        req.finish_reason = reason
+        self.finished.append(req)
+        self.slots[slot] = None
+        self._free.append(slot)
+        self.recycled += 1
+        return True

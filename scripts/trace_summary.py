@@ -85,6 +85,18 @@ def summarize_trace(data, top: int) -> None:
         print("\ncounters (last value):")
         for name, args in counters.items():
             print(f"  {name} = {args.get(name, args)}")
+    # serving digest (ISSUE 6): the prefill/decode spans the ServingEngine
+    # emits, folded into one line — tokens/sec-shaped, not span-table-shaped
+    if "decode_step" in spans or "prefill" in spans:
+        d = spans.get("decode_step", [0, 0.0, 0.0])
+        p = spans.get("prefill", [0, 0.0, 0.0])
+        line = f"\nserving digest: {d[0]} decode steps"
+        if d[0]:
+            line += f" (mean {d[1] / d[0] / 1e3:.3f} ms)"
+        line += f", {p[0]} prefills"
+        if p[0]:
+            line += f" (mean {p[1] / p[0] / 1e3:.3f} ms)"
+        print(line)
     if n_instant:
         print(f"\n{n_instant} instant events (not aggregated)")
 
@@ -134,6 +146,19 @@ def summarize_telemetry(data, top: int) -> None:
                 f"({ss.get('audit_failures', 0)} failed)")
         if ss.get("final_strategy"):
             line += f"   final strategy: {ss['final_strategy']}"
+        print(line)
+    srv = data.get("serving")
+    if srv:
+        # serving headline (ISSUE 6): request/token volume, queue pressure
+        # and the per-token latency tail of the serve run
+        line = (f"serving: {srv.get('requests_served', 0)} requests, "
+                f"{srv.get('tokens_generated', 0)} tokens   "
+                f"queue hwm: {srv.get('queue_depth_hwm', 0)}")
+        if srv.get("tokens_per_s") is not None:
+            line += f"   {srv['tokens_per_s']} tokens/s"
+        if srv.get("p99_token_ms") is not None:
+            line += (f"   p50/p99: {srv.get('p50_token_ms')}/"
+                     f"{srv['p99_token_ms']} ms")
         print(line)
     losses = data.get("loss_history", [])
     if losses:
