@@ -162,6 +162,13 @@ class FFConfig:
     # fit this many MiB (0 = disabled; the -ll:fsize analog for the
     # fallback cascade rather than the search)
     memory_budget_mb: int = 0
+    # ShardLint static analysis (flexflow_tpu/analysis,
+    # docs/static_analysis.md; ISSUE 7). "on" (default): stage 0 of the
+    # fallback cascade, candidate pruning in the Unity search, and the
+    # pre-serve FF005 check. "strict": additionally analyze EVERY compiled
+    # strategy (explicit/imported/searched) and refuse on errors. "off":
+    # dynamic checks only (the pre-ISSUE 7 behavior).
+    static_analysis: str = "on"
 
     # serving engine (flexflow_tpu/serving, docs/serving.md; ISSUE 6).
     # The reference's only inference artifact is an incomplete Triton
@@ -323,6 +330,13 @@ class FFConfig:
                 self.audit_tol = float(_next())
             elif a == "--memory-budget-mb":
                 self.memory_budget_mb = int(_next())
+            elif a == "--static-analysis":
+                v = _next()
+                if v not in ("on", "off", "strict"):
+                    raise ValueError(
+                        f"--static-analysis expects on|off|strict, got "
+                        f"{v!r}")
+                self.static_analysis = v
             elif a == "--serve":
                 self.serve = True
             elif a == "--max-decode-len":

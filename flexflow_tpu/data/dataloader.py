@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 from queue import Queue
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 

@@ -133,6 +133,15 @@ def _device_snapshot(tree):
     return jax.tree_util.tree_map(snap, tree)
 
 
+# The FF002 donation-aliasing contract (analysis/rules.
+# donation_spec_for_training) reads this flag rather than hardcoding it:
+# it is True because CheckpointManager.save_async routes every retained
+# tree through _device_snapshot above. Bypass the snapshot (or flip this
+# without doing so) and ShardLint flags the post-step reference to a
+# donated buffer — the PR 4 bug class.
+SNAPSHOT_DEVICE_COPY = True
+
+
 # -------------------------------------------------------------------- saving
 def save_checkpoint(ffmodel, directory: str, step: int = 0,
                     train_state: Optional[Dict[str, Any]] = None,

@@ -10,12 +10,9 @@ allreduce in the optimizer (SURVEY §7 architecture mapping).
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
-from ..ffconst import CompMode, DataType, OperatorType, dtype_to_jnp
+from ..ffconst import DataType, OperatorType, dtype_to_jnp
 from ..ops.base import OpContext
 from ..parallel.pcg import PCG, PCGNode
 from ..parallel.strategy import Strategy

@@ -10,7 +10,6 @@ with ``next()`` per-step hyperparameter schedule, optimizer.h:27-96) is kept.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
 
 
 class Optimizer:

@@ -26,7 +26,7 @@ executor runs. See ``docs/remat.md``.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import List
 
 from ..ffconst import OperatorType
 

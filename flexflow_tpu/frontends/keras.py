@@ -9,7 +9,7 @@ losses.py/metrics.py/optimizers.py.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 

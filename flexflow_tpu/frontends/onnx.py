@@ -7,11 +7,11 @@ absent.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import numpy as np
 
-from ..ffconst import ActiMode, DataType, PoolType
+from ..ffconst import PoolType
 from ..model import FFModel
 from ..tensor import Tensor
 

@@ -10,7 +10,7 @@ correctness tier, tests/align (SURVEY §4).
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 

@@ -13,7 +13,6 @@ ppermute DMA asynchronously).
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as np

@@ -22,7 +22,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from ._common import (DEFAULT_BLOCK_ROWS, pick_block_rows as _pick_block_rows,
+from ._common import (pick_block_rows as _pick_block_rows,
                       resolve_interpret as _resolve_interpret)
 
 

@@ -641,6 +641,26 @@ class FFModel:
             self.strategy = self._run_search(pcg, n_dev)
             self.mesh = mesh_for_strategy(self.config, self.strategy)
 
+        # --static-analysis strict: ShardLint judges EVERY compiled plan
+        # (explicit, imported, or searched) before the executor exists —
+        # the compile-time analog of cascade stage 0 (ISSUE 7). The
+        # default "on" runs analysis only where it replaces dynamic work
+        # (cascade, search pruning, pre-serve), keeping plain compiles at
+        # zero added cost.
+        if (getattr(self.config, "static_analysis", "on") or "on") == \
+                "strict" and self.strategy is not None:
+            from .analysis import StaticAnalysisError, analyze_model
+
+            # the SAME full pass the cascade's stage 0 runs (remat plan
+            # resolved, donation contract included) — one entry point, so
+            # the two paths cannot drift; pcg is passed explicitly
+            # because self.pcg binds later in compile
+            report = analyze_model(self, pcg=pcg)
+            if report.errors:
+                raise StaticAnalysisError(
+                    report, context="compile under --static-analysis "
+                    "strict")
+
         if self.config.export_strategy_file and \
                 not getattr(self, "_exported_search_target", False):
             with open(self.config.export_strategy_file, "w") as f:

@@ -9,7 +9,7 @@ placements our hybrid strategy / unity search reproduce via 'table' sharding.
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Sequence
 
 from ..ffconst import ActiMode, AggrMode, DataType
 from ..model import FFModel

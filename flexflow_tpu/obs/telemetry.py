@@ -100,6 +100,12 @@ class StepTelemetry:
         self.audit_runs: int = 0
         self.audit_failures: int = 0
         self.final_strategy: Optional[str] = None
+        # static-analysis counters (ISSUE 7): ShardLint runs from cascade
+        # stage 0 — analyses run, candidates statically rejected, and the
+        # rule IDs (FF001..FF006) that fired
+        self.static_checks: int = 0
+        self.static_rejects: int = 0
+        self.static_rules: List[str] = []
         # serving counters (ISSUE 6): filled by the ServingEngine after a
         # serve() run — requests completed, tokens emitted, the bounded
         # admission queue's high-water mark and the per-token latency
@@ -208,6 +214,12 @@ class StepTelemetry:
             if self.final_strategy is not None:
                 ss["final_strategy"] = self.final_strategy
             out["strategy_safety"] = ss
+        if self.static_checks:
+            out["strategy_static"] = {
+                "checks": self.static_checks,
+                "rejects": self.static_rejects,
+                "rules": list(self.static_rules),
+            }
         if self.requests_served or self.tokens_generated:
             sv: Dict[str, Any] = {
                 "requests_served": self.requests_served,

@@ -15,11 +15,10 @@ absent in the reference — SURVEY §5 long-context).
 """
 from __future__ import annotations
 
-from typing import Dict, List
 
 import numpy as np
 
-from ..ffconst import DataType, OperatorType
+from ..ffconst import OperatorType
 from .base import Op, OpContext, register_op
 
 

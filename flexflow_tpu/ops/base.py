@@ -20,7 +20,7 @@ Op *Params* dataclass-equality/hashing for node dedup (reference:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 

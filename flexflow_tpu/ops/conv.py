@@ -7,11 +7,10 @@ kernels are stored HWIO which is what lax.conv_general_dilated wants.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
 
 import numpy as np
 
-from ..ffconst import ActiMode, DataType, OperatorType, PoolType
+from ..ffconst import ActiMode, OperatorType, PoolType
 from .base import Op, OpContext, register_op
 from .linear import apply_activation
 

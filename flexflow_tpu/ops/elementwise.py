@@ -7,11 +7,11 @@ whole point of not hand-writing kernels for them.
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Tuple
 
 import numpy as np
 
-from ..ffconst import DataType, OperatorType, dtype_to_jnp
+from ..ffconst import OperatorType, dtype_to_jnp
 from .base import Op, OpContext, register_op
 
 

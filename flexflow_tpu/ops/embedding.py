@@ -7,9 +7,7 @@ collective when the table dim is sharded over the mesh).
 """
 from __future__ import annotations
 
-import numpy as np
-
-from ..ffconst import AggrMode, DataType, OperatorType
+from ..ffconst import AggrMode, OperatorType
 from .base import Op, OpContext, register_op
 
 

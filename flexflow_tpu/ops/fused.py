@@ -15,7 +15,7 @@ round-trips, matching what XLA actually emits.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 

@@ -14,11 +14,10 @@ sharding of one weight dim:
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
-from ..ffconst import ActiMode, DataType, OperatorType
+from ..ffconst import ActiMode, OperatorType
 from .base import Op, OpContext, register_op
 
 

@@ -25,11 +25,10 @@ token all-to-all is emitted by XLA at the sharding boundary.
 """
 from __future__ import annotations
 
-from typing import List, Tuple
 
 import numpy as np
 
-from ..ffconst import DataType, OperatorType
+from ..ffconst import OperatorType
 from .base import Op, OpContext, register_op
 
 

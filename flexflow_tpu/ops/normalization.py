@@ -7,10 +7,6 @@ attention path.
 """
 from __future__ import annotations
 
-from typing import List
-
-import numpy as np
-
 from ..ffconst import OperatorType
 from .base import Op, OpContext, register_op
 

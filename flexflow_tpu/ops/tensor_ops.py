@@ -8,7 +8,7 @@ topk,batch_matmul}.cc. All are single XLA HLO ops here — including top-k
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Tuple
 
 import numpy as np
 

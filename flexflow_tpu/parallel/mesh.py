@@ -11,7 +11,7 @@ parallel), optional ``expert`` and ``seq`` axes for EP/SP strategies.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -20,7 +20,7 @@ The node's target ParallelTensorShape is attached by the strategy assignment
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Optional
 
 from ..ffconst import OperatorType
 from ..ops.base import Op, OpContext, register_op

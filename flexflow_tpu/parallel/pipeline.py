@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..ffconst import DataType, LossType, OperatorType, dtype_to_jnp
+from ..ffconst import LossType, OperatorType, dtype_to_jnp
 from .pcg import PCG, PCGNode
 
 BoundaryT = Tuple[int, int]  # (guid, out_idx)

@@ -9,7 +9,7 @@ seeds, and (c) are what `--import-strategy` files look like.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 from ..ffconst import OperatorType
 from ..machine_view import MachineView

@@ -15,11 +15,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
-from ..ffconst import OperatorType
 from ..machine_view import MachineView
-from .pcg import PCG, PCGNode
+from .pcg import PCG
 
 # A spec entry is None or a mesh-axis name or tuple of names, one per tensor dim
 SpecT = Tuple[Optional[Any], ...]

@@ -13,7 +13,7 @@ Reduction/Replicate parallel-op semantics, src/parallel_ops/).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 from .ffconst import DataType
 

@@ -25,7 +25,8 @@ checkpoint, never the run:
 ``docs/fault_tolerance.md`` and ``docs/strategy_safety.md``.
 """
 from .audit import AuditError, AuditReport, audit_strategy  # noqa: F401
-from .chaos import ChaosPlan, corrupt_checkpoint  # noqa: F401
+from .chaos import (ChaosPlan, corrupt_checkpoint,  # noqa: F401
+                    inject_wrong_reshard)
 from .elastic import elastic_restore  # noqa: F401
 from .fallback import (MemoryBudgetError, StrategyCascade,  # noqa: F401
                        StrategyCompileError, StrategySafetyError)

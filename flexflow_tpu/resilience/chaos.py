@@ -16,11 +16,30 @@ every recovery path runs on CPU in the fast test tier:
 * ``ChaosPlan(fail_compiles=N)`` — the strategy-safety cascade's
   compile check (resilience/fallback.py) raises a scripted XLA-compile
   failure for the first N candidates, driving the ranked-fallback path.
-* ``ChaosPlan(wrong_reshard=True)`` — the parallel-correctness auditor's
-  candidate probe reports a grad-norm scaled by ``wrong_reshard_factor``
-  (default 2.0 — the signature of a double-counted gradient allreduce
-  from a miscompiled resharding rule), so the audit-reject path runs on
-  CPU without a genuinely miscompiled plan.
+* ``ChaosPlan(wrong_reshard=True)`` — a wrong-reshard defect for the
+  strategy-safety layer, in one of three modes
+  (``wrong_reshard_mode``):
+
+  - ``"duplicate"`` (graph-level): :func:`inject_wrong_reshard`
+    inserts a REAL doubled-reduction node into the candidate PCG —
+    statically visible (the analyzer's FF001 names it with zero step
+    executions) AND dynamically real (the node scales its value by
+    ``wrong_reshard_factor`` under a multi-device mesh, so the
+    parallel-correctness audit's probe diverges from the single-device
+    reference exactly like a double-counted allreduce). The static check
+    and the dynamic audit are exercised against the same concrete
+    defect. Note the end-to-end loss/grad-norm movement is damped by the
+    loss (softmax shift tolerance): with the default ``--audit-tol``
+    0.05 pass ``wrong_reshard_factor >= 3`` for a reliably-failing
+    audit; the static FF001 catch is factor-independent.
+  - ``"drop"`` (graph-level): remove a real reduction edge — the
+    unreduced-partial FF001 class. Statically caught; dynamically
+    invisible under XLA SPMD (the partitioner re-derives the psum from
+    the shardings), which is precisely why the static check exists.
+  - ``"scale"`` (legacy, the default): the auditor merely scales the
+    candidate's reported grad norm by ``wrong_reshard_factor`` — no
+    graph change; works on any graph, including pure-dp plans with no
+    reduction to break.
 
 Pass a plan to ``Model.fit(..., chaos=plan)``. Injection is once-per-step
 by default so a run that rolls back and re-executes step K replays it
@@ -53,7 +72,8 @@ class ChaosPlan:
                  once: bool = True,
                  fail_compiles: int = 0,
                  wrong_reshard: bool = False,
-                 wrong_reshard_factor: float = 2.0):
+                 wrong_reshard_factor: float = 2.0,
+                 wrong_reshard_mode: str = "scale"):
         self.nan_at_steps = {int(s) for s in nan_at_steps}
         self.preempt_at_step = (None if preempt_at_step is None
                                 else int(preempt_at_step))
@@ -67,7 +87,13 @@ class ChaosPlan:
         self.compile_failures_injected = 0
         self.wrong_reshard = bool(wrong_reshard)
         self.wrong_reshard_factor = float(wrong_reshard_factor)
+        if wrong_reshard_mode not in ("scale", "drop", "duplicate"):
+            raise ValueError(
+                f"wrong_reshard_mode must be scale|drop|duplicate, got "
+                f"{wrong_reshard_mode!r}")
+        self.wrong_reshard_mode = wrong_reshard_mode
         self.wrong_reshards_injected = 0
+        self.injected_defect = ""  # description of the graph-level defect
 
     # -- hooks called by Model.fit ------------------------------------------
     def poison_batch(self, step: int, bx):
@@ -111,16 +137,49 @@ class ChaosPlan:
 
     def consume_wrong_reshard(self) -> float:
         """Grad-norm factor the auditor applies to the CANDIDATE probe —
-        != 1.0 while the injection is pending, simulating a plan whose
-        miscompiled resharding double-counts the gradient allreduce (loss
-        matches the reference, the grad norm is off by the factor). With
-        ``once=True`` it fires on a single audit, so the cascade's next
-        candidate audits clean."""
-        if self.wrong_reshard and (not self.once
-                                   or self.wrong_reshards_injected == 0):
+        != 1.0 while a ``"scale"``-mode injection is pending, simulating a
+        plan whose miscompiled resharding double-counts the gradient
+        allreduce (loss matches the reference, the grad norm is off by
+        the factor). Graph-level modes return 1.0: their defect is a real
+        node in the graph (``apply_wrong_reshard``), not a reporting
+        tweak. With ``once=True`` it fires on a single audit, so the
+        cascade's next candidate audits clean."""
+        if self.wrong_reshard and self.wrong_reshard_mode == "scale" and \
+                (not self.once or self.wrong_reshards_injected == 0):
             self.wrong_reshards_injected += 1
             return self.wrong_reshard_factor
         return 1.0
+
+    def graph_defect_pending(self) -> bool:
+        """A graph-level wrong-reshard injection (mode drop/duplicate)
+        that has not been applied yet — the cascade applies it to the
+        model's live PCG at the top of ``preverify``."""
+        return (self.wrong_reshard
+                and self.wrong_reshard_mode in ("drop", "duplicate")
+                and (not self.once or self.wrong_reshards_injected == 0))
+
+    def apply_wrong_reshard(self, ffmodel) -> str:
+        """Mutate the model's live PCG with the scripted reshard defect
+        (``inject_wrong_reshard``). A graph with no reduction edge to
+        break (e.g. a pure-dp plan) degrades to the legacy ``"scale"``
+        simulation with a warning, so the injection never silently does
+        nothing. Returns a description of what was injected."""
+        try:
+            desc = inject_wrong_reshard(ffmodel.pcg, ffmodel.strategy,
+                                        mode=self.wrong_reshard_mode,
+                                        factor=self.wrong_reshard_factor)
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(
+                f"ChaosPlan wrong_reshard_mode="
+                f"{self.wrong_reshard_mode!r}: no injection site ({e}); "
+                "falling back to the legacy grad-norm scale simulation")
+            self.wrong_reshard_mode = "scale"
+            return ""
+        self.wrong_reshards_injected += 1
+        self.injected_defect = desc
+        return desc
 
     def maybe_preempt(self, step: int) -> None:
         """Deliver the scripted preemption signal before step ``step``
@@ -133,6 +192,127 @@ class ChaosPlan:
             return
         self.preempted_at = step
         os.kill(os.getpid(), self.preempt_signal)
+
+
+class _InjectedReductionOp:
+    """A REAL doubled-reduction node (lazy subclass factory below): its
+    forward scales the value by ``chaos_factor`` — but only under a
+    multi-device mesh, exactly like a double-counted allreduce, whose
+    damage exists only in the parallel plan. The single-device audit
+    reference therefore computes the TRUE value and the divergence is
+    caught dynamically, while the analyzer's FF001 names the node
+    statically (it is an OP_REDUCTION whose input is not a partial sum)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..parallel.parallel_op import ReductionOp
+
+        class _Injected(ReductionOp):
+            def forward(self, params, inputs, ctx):
+                x = inputs[0]
+                n_dev = (int(ctx.mesh.devices.size)
+                         if ctx.mesh is not None else 1)
+                factor = float(self.attrs.get("chaos_factor", 2.0))
+                if n_dev > 1 and factor != 1.0:
+                    import jax.numpy as jnp
+
+                    x = x * jnp.asarray(factor, dtype=x.dtype)
+                return [x]
+
+        return _Injected(*args, **kwargs)
+
+
+def inject_wrong_reshard(pcg, strategy, mode: str = "duplicate",
+                         factor: float = 2.0) -> str:
+    """Mutate ``pcg`` IN PLACE with a graph-level wrong-reshard defect.
+
+    ``mode="duplicate"``: insert a :class:`_InjectedReductionOp` on the
+    output edge of the first reduction site — an explicit ``OP_REDUCTION``
+    node (a searched plan after ``insert_parallel_ops``) or a partial-sum
+    producer whose ``output_spec`` performs the reduce (a hand/spec-based
+    plan) — modelling a duplicated reduction edge. ``mode="drop"``:
+    remove that reduction — splice out the ``OP_REDUCTION`` node, or strip
+    the producer's reducing ``output_spec`` — modelling a dropped
+    reduction edge (statically FF001-unreduced; numerically invisible
+    under XLA SPMD, which is why only the static check can catch it).
+
+    Raises ``ValueError`` when the graph has no reduction site (nothing
+    to break — e.g. a pure data-parallel plan). Returns a description
+    naming the defect and the node, mirroring the analyzer's diagnostic.
+    """
+    from ..analysis.interp import _partial_axes_produced
+    from ..ffconst import OperatorType
+
+    node_strats = strategy.node_strategies if strategy is not None else {}
+    site = None  # (node, kind): kind in ("reduction", "producer")
+    for node in pcg.compute_nodes():
+        if node.op.op_type == OperatorType.OP_REDUCTION and \
+                pcg.consumers(node.guid):
+            site = (node, "reduction")
+            break
+    if site is None:
+        for node in pcg.compute_nodes():
+            ns = node_strats.get(node.guid)
+            if _partial_axes_produced(node, ns) and \
+                    ns is not None and ns.output_spec is not None and \
+                    pcg.consumers(node.guid):
+                site = (node, "producer")
+                break
+    if site is None:
+        raise ValueError(
+            "no reduction edge to break: the graph has no OP_REDUCTION "
+            "node and no partial-sum producer with consumers")
+    node, kind = site
+
+    if mode == "drop":
+        if kind == "reduction":
+            src = node.inputs[0]
+            for c in pcg.consumers(node.guid):
+                cn = pcg.nodes[c]
+                cn.inputs = [src if g == node.guid else (g, i)
+                             for g, i in cn.inputs]
+            del pcg.nodes[node.guid]
+            pcg._order.remove(node.guid)
+            node_strats.pop(node.guid, None)
+            return (f"dropped reduction node '{node.name}' (consumers "
+                    "splice through to its unreduced input)")
+        ns = node_strats[node.guid]
+        ns.output_spec = None
+        return (f"dropped the reducing output constraint of partial-sum "
+                f"producer '{node.name}'")
+
+    if mode != "duplicate":
+        raise ValueError(f"unknown graph defect mode {mode!r}")
+    if kind == "reduction":
+        axes = tuple(node.op.attrs.get("axes") or ())
+        degree = int(node.op.attrs.get("degree", 2) or 2)
+    else:
+        axes = tuple(_partial_axes_produced(node,
+                                            node_strats.get(node.guid)))
+        axis_size = dict(zip(tuple(strategy.axis_names),
+                             (int(s) for s in strategy.mesh_shape)))
+        degree = int(axis_size.get(axes[0], 2)) if axes else 2
+    op = _InjectedReductionOp(
+        f"chaos_dup_reduction_{node.guid}",
+        {"dim": 0, "degree": degree, "axes": axes,
+         "chaos_factor": float(factor)},
+        node.op.data_type, num_inputs=1)
+    consumers = pcg.consumers(node.guid)
+    first = pcg.nodes[consumers[0]]
+    slot = [s for s, (g, _i) in enumerate(first.inputs)
+            if g == node.guid][0]
+    new = pcg.insert_node_on_edge(consumers[0], slot, op)
+    # insert_node_on_edge rewires exactly one slot; a consumer referencing
+    # the reduction output in SEVERAL input slots (e.g. add(r, r)) must
+    # have all of them routed through the injected node, like the
+    # consumers[1:] rewiring below — else one edge bypasses the defect
+    first.inputs = [(new.guid, 0) if g == node.guid else (g, i)
+                    for g, i in first.inputs]
+    for c in consumers[1:]:
+        cn = pcg.nodes[c]
+        cn.inputs = [(new.guid, 0) if g == node.guid else (g, i)
+                     for g, i in cn.inputs]
+    return (f"duplicated the reduction after '{node.name}' as "
+            f"'{new.op.name}' (x{factor:g} under a multi-device mesh)")
 
 
 def corrupt_checkpoint(path: str, mode: str = "truncate") -> str:

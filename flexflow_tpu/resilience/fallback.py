@@ -8,6 +8,12 @@ fault-tolerant, the way PR 4 made the step loop fault-tolerant. Verification
 runs ONCE before the fit loop (``StrategyCascade.preverify``); for the
 active strategy, in order:
 
+0. **static analysis** — ShardLint (``flexflow_tpu.analysis``, ISSUE 7):
+   the placement-lattice abstract interpreter plus rules FF001-FF006
+   over the live PCG + Strategy. A statically-rejected candidate
+   degrades down the ranked chain WITHOUT paying a compile or probe
+   step (the ``compile_probes`` counter proves it); ``--static-analysis
+   off`` disables the stage;
 1. **preflight** — static divisibility audit (``preflight.py``), free;
 2. **compile check** — build the exact jitted step the loop will run and
    execute ONE step on throwaway device-side copies: XLA compile errors
@@ -35,6 +41,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.report import StaticAnalysisError
 from .audit import AuditError
 from .preflight import PreflightError, preflight_strategy
 
@@ -53,7 +60,8 @@ class MemoryBudgetError(StrategySafetyError):
     """XLA's compiled peak exceeds ``--memory-budget-mb``."""
 
 
-_FAILURE_KINDS = (PreflightError, AuditError, StrategySafetyError)
+_FAILURE_KINDS = (PreflightError, AuditError, StrategySafetyError,
+                  StaticAnalysisError)
 
 
 class StrategyCascade:
@@ -70,6 +78,17 @@ class StrategyCascade:
         self.tol = float(getattr(cfg, "audit_tol", 0.05) or 0.05)
         self.budget_bytes = int(
             getattr(cfg, "memory_budget_mb", 0) or 0) * 2 ** 20
+        # stage 0 (ISSUE 7): ShardLint static analysis — on unless
+        # explicitly disabled; pure Python over graph metadata, so it is
+        # free relative to any probe the cascade was armed to run anyway
+        self.static_on = (getattr(cfg, "static_analysis", "on")
+                          or "on") != "off"
+        self.static_checks = 0
+        self.static_rejects = 0
+        self.static_rules: List[str] = []
+        # compile/probe executions — the acceptance counter: a statically
+        # rejected candidate must never increment this
+        self.compile_probes = 0
         self.fallbacks = 0
         self.audits = 0
         self.audit_failures = 0
@@ -116,6 +135,14 @@ class StrategyCascade:
         probe_xs = [np.asarray(a[:n]) for a in xs]
         probe_y = np.asarray(y[:n])
         run_probes = n == int(batch_size)
+        # graph-level chaos (ISSUE 7 satellite): a scripted drop/duplicate
+        # of a real reduction edge lands in the live PCG here, so the
+        # static stage and the dynamic audit judge the SAME defect
+        if self.chaos is not None and getattr(
+                self.chaos, "graph_defect_pending", lambda: False)():
+            desc = self.chaos.apply_wrong_reshard(model)
+            if desc:
+                self.tracer.event("chaos_graph_defect", detail=desc[:300])
         while True:
             desc = (model.strategy.describe()
                     if model.strategy is not None else "?")
@@ -166,8 +193,13 @@ class StrategyCascade:
         import jax
 
         model = self.model
+        if self.static_on:
+            self._static_check(desc)
+        # stage 0's analyzer already ran FF006 (the per-node spec half of
+        # preflight) over this exact (pcg, strategy) — don't walk it twice
         preflight_strategy(model.pcg, model.strategy,
-                           n_dev=len(jax.devices()), batch_size=batch_size)
+                           n_dev=len(jax.devices()), batch_size=batch_size,
+                           spec_checks=not self.static_on)
         if not run_probes:
             return
         self._compile_check(desc, probe_xs, probe_y)
@@ -175,6 +207,25 @@ class StrategyCascade:
             self._memory_check(desc, probe_xs, probe_y)
         if self.audit_on:
             self._audit_check(desc, probe_xs, probe_y)
+
+    def _static_check(self, desc: str) -> None:
+        """Stage 0 (ISSUE 7): run ShardLint over the candidate. An
+        erroring report raises :class:`StaticAnalysisError` — rejection is
+        free (no compile, no probe step; ``compile_probes`` untouched)."""
+        from ..analysis import analyze_model
+
+        self.static_checks += 1
+        report = analyze_model(self.model)
+        self.tracer.event("strategy_static", strategy=desc,
+                          diagnostics=len(report.diagnostics),
+                          errors=len(report.errors),
+                          rules=",".join(report.rules_fired()))
+        if report.errors:
+            self.static_rejects += 1
+            for d in report.errors:
+                if d.rule_id not in self.static_rules:
+                    self.static_rules.append(d.rule_id)
+            raise StaticAnalysisError(report, context=desc)
 
     def _compile_check(self, desc: str, probe_xs, probe_y) -> None:
         """Compile the EXACT jitted step the loop will dispatch (guarded
@@ -184,6 +235,7 @@ class StrategyCascade:
         import jax
 
         model = self.model
+        self.compile_probes += 1
         if self.chaos is not None and self.chaos.consume_compile_failure():
             raise StrategyCompileError(
                 f"chaos: injected XLA compile failure for {desc}")
@@ -320,3 +372,8 @@ class StrategyCascade:
         telemetry.audit_runs += self.audits
         telemetry.audit_failures += self.audit_failures
         telemetry.final_strategy = self.final_desc
+        telemetry.static_checks += self.static_checks
+        telemetry.static_rejects += self.static_rejects
+        for r in self.static_rules:
+            if r not in telemetry.static_rules:
+                telemetry.static_rules.append(r)

@@ -10,6 +10,9 @@ preflight (ISSUE 5):
   visible devices, batch vs data-parallel degree, every PartitionSpec axis
   exists in the mesh, sharded weight/output dims divide their axis size,
   hybrid ICI x DCN factors multiply out, pipeline grid sanity, remat level.
+  The per-node PartitionSpec half routes through the ShardLint FF006
+  checker (``analysis/rules.check_shapes``, ISSUE 7) — one implementation
+  for both validation paths, same historic error texts.
   Run by ``FFModel.compile`` on explicit / imported strategies (the
   untrusted inputs — searched strategies are divisible by construction)
   and by the fallback cascade on every candidate it considers.
@@ -70,10 +73,15 @@ def preflight_config(config) -> None:
     if remat and remat not in ("none", "selective", "full"):
         raise PreflightError(
             f"--remat expects none|selective|full, got {remat!r}")
+    sa = (getattr(config, "static_analysis", "on") or "on")
+    if sa not in ("on", "off", "strict"):
+        raise PreflightError(
+            f"--static-analysis expects on|off|strict, got {sa!r}")
 
 
 # --------------------------------------------------------------- strategy
-def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int) -> None:
+def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int,
+                       spec_checks: bool = True) -> None:
     """Static divisibility audit of a Strategy against the machine it is
     about to compile for. Raises :class:`PreflightError` with the offending
     node / axis named; a passing strategy may still fail XLA (that is what
@@ -136,45 +144,20 @@ def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int) -> None:
                 f"must split into {micro} microbatches each divisible by "
                 f"dp={pdp}")
 
-    axis_size = dict(zip(axes, ms))
+    # per-node PartitionSpec dataflow (axis exists, sharded dims divide):
+    # routed through the ShardLint FF006 checker (ISSUE 7 — one
+    # implementation, two consumers) so preflight and the static analyzer
+    # cannot drift; the diagnostic messages ARE the historic preflight
+    # error texts, raised here with the same first-failure semantics.
+    # ``spec_checks=False`` lets a caller that ALREADY ran the analyzer
+    # (the cascade's stage 0 covers FF006) skip the duplicate walk.
+    if not spec_checks:
+        return
+    from ..analysis.rules import check_shapes
 
-    def check_spec(where: str, spec, shape) -> None:
-        for dim, e in enumerate(spec or ()):
-            names = e if isinstance(e, (tuple, list)) else (e,)
-            for a in names:
-                if a is None:
-                    continue
-                if a not in axis_size:
-                    raise PreflightError(
-                        f"{where}: PartitionSpec names mesh axis {a!r} "
-                        f"(dim {dim}) but the strategy's mesh axes are "
-                        f"{axes}")
-                sz = axis_size[a]
-                if shape is not None and dim < len(shape) and sz > 1 and \
-                        shape[dim] % sz:
-                    raise PreflightError(
-                        f"{where}: dim {dim} has size {shape[dim]}, not "
-                        f"divisible by mesh axis {a!r} (size {sz}); the "
-                        "plan cannot shard it evenly")
-
-    for guid, ns in strategy.node_strategies.items():
-        node = pcg.nodes.get(guid) if pcg is not None else None
-        name = node.name if node is not None else f"node guid {guid}"
-        wshapes = {}
-        if node is not None and ns.weight_specs:
-            try:
-                in_shapes = [pcg.nodes[g].out_shapes[i]
-                             for g, i in node.inputs]
-                wshapes = {w: tuple(s) for w, (s, _d, _i) in
-                           node.op.weight_specs(in_shapes).items()}
-            except Exception:
-                wshapes = {}
-        for wname, spec in (ns.weight_specs or {}).items():
-            check_spec(f"{name}.{wname}", spec, wshapes.get(wname))
-        if ns.output_spec:
-            oshape = (tuple(node.out_shapes[0])
-                      if node is not None and node.out_shapes else None)
-            check_spec(f"{name} output", ns.output_spec, oshape)
+    diags = check_shapes(pcg, strategy)
+    if diags:
+        raise PreflightError(diags[0].message)
 
 
 # ------------------------------------------------------------------ batch

@@ -50,7 +50,7 @@ import numpy as np
 from ..ffconst import OperatorType
 from ..machine_view import MachineView
 from ..parallel.pcg import PCG, PCGNode
-from ..parallel.strategy import NodeStrategy, Strategy
+from ..parallel.strategy import Strategy
 from ..utils.recursive_logger import RecursiveLogger
 from .machine_model import TPUMachineModel
 from .simulator import OpSharding, Simulator, selfcheck_enabled
@@ -158,6 +158,9 @@ class SearchResult:
     # strategy_json — what the executor's fallback cascade degrades
     # through when the winner fails to compile / OOMs / fails the audit
     ranked: List[RankedCandidate] = dataclasses.field(default_factory=list)
+    # candidates ShardLint rejected before simulation (ISSUE 7): free
+    # rejections — none of these paid an op_cost/simulate call
+    pruned_static: int = 0
 
 
 def dcn_placements(dp: int, tp: int, num_hosts: int
@@ -1360,6 +1363,21 @@ def unity_search(pcg: PCG, config, n_dev: int,
     rank_budget = hbm_budget if config.perform_memory_search else None
     pipe_cands: List[RankedCandidate] = []
 
+    # ShardLint candidate pruning (ISSUE 7): statically ill-formed
+    # candidates (FF001 partial-sum defects, FF006 indivisible shardings)
+    # are rejected after the DP optimizer assigns shardings but BEFORE
+    # the final simulate/memory pricing and the ranked pool — a broken
+    # rewrite/substitution rule can never win the search or ride a
+    # ranked fallback chain. Every lambda's assignment is analyzed (the
+    # trade-off changes the per-node shardings), but a pruned PLAN is
+    # counted/logged once — pruned_static reports distinct plans, like
+    # the ranked pool's dedup.
+    static_on = (getattr(config, "static_analysis", "on") or "on") != "off"
+    if static_on:
+        from ..analysis import analyze_candidate
+    pruned_static = [0]
+    pruned_keys: set = set()
+
     def pool_consider(r: SearchResult) -> None:
         feas = rank_budget is None or r.sim_memory <= rank_budget
         key = (tuple(r.mesh_shape), tuple(r.dcn), r.remat)
@@ -1392,6 +1410,25 @@ def unity_search(pcg: PCG, config, n_dev: int,
                                                 "base_optimize_threshold",
                                                 0),
                         search_log=slog, remat=remat)
+                    strat = assignment_to_strategy(
+                        g, a, s, dp, tp, machine=machine,
+                        dcn=(dp_dcn, tp_dcn))
+                    strat.remat = remat
+                    if static_on:
+                        rep = analyze_candidate(g, strat)
+                        if rep.errors:
+                            key = (dp, tp, dp_dcn, tp_dcn, remat)
+                            if key not in pruned_keys:
+                                pruned_keys.add(key)
+                                pruned_static[0] += 1
+                                slog.log(
+                                    event="pruned_static", dp=dp, tp=tp,
+                                    dcn=[dp_dcn, tp_dcn],
+                                    lam=round(lam, 4), remat=remat,
+                                    rules=rep.rules_fired(),
+                                    first=rep.errors[0]
+                                    .format_line()[:300])
+                            continue
                     _, mem = sim.simulate(g, a, s)
                     _log.info(
                         "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f remat=%s -> "
@@ -1412,10 +1449,6 @@ def unity_search(pcg: PCG, config, n_dev: int,
                                  (sweep_best[0]
                                   if sweep_best[0] != float("inf")
                                   else t) * 1e3, 4))
-                    strat = assignment_to_strategy(
-                        g, a, s, dp, tp, machine=machine,
-                        dcn=(dp_dcn, tp_dcn))
-                    strat.remat = remat
                     results.append(SearchResult(
                         strategy=strat,
                         assignment=a, sim_time=t, sim_memory=mem,
@@ -1561,6 +1594,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
         best.search_wall_s = search_wall_s
         best.candidates = candidates
         best.cache_stats = cache_stats
+        best.pruned_static = pruned_static[0]
         # ranked fallback chain (ISSUE 5): persisted on the result AND in
         # the search log, so the compile-time cascade (and a post-mortem of
         # one) can replay which plans were next in line
@@ -1584,6 +1618,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
                  candidates=candidates,
                  candidates_per_s=round(candidates / search_wall_s, 2)
                  if search_wall_s > 0 else None,
+                 pruned_static=pruned_static[0],
                  **cache_stats)
     slog.close()
     if best is None:

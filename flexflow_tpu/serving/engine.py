@@ -132,9 +132,26 @@ class ServingEngine:
 
     # ------------------------------------------------------------ validation
     def _validate_graph(self) -> None:
-        from .kvcache import is_position_constant
-
         pcg = self.executor.pcg
+        # ShardLint pre-serve pass (ISSUE 7): the FF005 serving-state
+        # reachability rule promotes the fused-stateful runtime refusal
+        # into a static diagnostic with a rule ID and fix hint. ONE
+        # detection implementation either way — with --static-analysis
+        # off the same checker still backstops the engine (it must never
+        # decode history-free garbage), just phrased as the plain
+        # runtime refusal without a rule ID.
+        from ..analysis import check_serving_graph
+
+        diags = check_serving_graph(pcg)
+        if diags:
+            if (getattr(self.model.config, "static_analysis", "on")
+                    or "on") != "off":
+                raise NotImplementedError(
+                    "; ".join(d.format_line() for d in diags))
+            d = diags[0]
+            raise NotImplementedError(
+                f"{d.node}: {d.message}; recompile without --fusion "
+                "to serve")
         final = pcg.nodes[self.executor.final_guid]
         out = final.out_shapes[self.executor.final_out_idx]
         if len(out) != 3:
@@ -150,24 +167,9 @@ class ServingEngine:
                     f"{node.name}: OP_SDPA graphs (torch frontend) have no "
                     "serving decode path yet; build with "
                     "multihead_attention(causal=True)")
-            if ot == OperatorType.OP_FUSED:
-                # a fused region hides its members from the per-node
-                # serving machinery: stateful sub-ops would decode without
-                # history and a fused position constant escapes the
-                # override hook — refuse LOUDLY rather than generate
-                # garbage (plain elementwise fusions are fine)
-                for sub in node.op.sub_ops:
-                    if sub.op_type in (
-                            OperatorType.OP_MULTIHEAD_ATTENTION,
-                            OperatorType.OP_LSTM) or (
-                            sub.op_type == OperatorType.OP_CONSTANT
-                            and is_position_constant(
-                                sub.attrs.get("value"))):
-                        raise NotImplementedError(
-                            f"{node.name}: fusion folded the stateful/"
-                            f"position op {sub.name} into a region the "
-                            "serving engine cannot thread decode state "
-                            "through; recompile without --fusion to serve")
+            # fused regions hiding stateful/position sub-ops were already
+            # refused above via analysis.check_serving_graph (FF005) —
+            # the single implementation of that judgement
             if ot == OperatorType.OP_MULTIHEAD_ATTENTION:
                 if not node.op.attrs.get("causal", False):
                     raise ValueError(

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Assert every implemented ShardLint rule ID is documented.
+
+Same pattern as ``check_docs_flags.py`` (flags vs docs/python_api.md),
+for the static analyzer: every rule registered in
+``flexflow_tpu/analysis/rules.py`` (the ``RULES`` registry — the IDs are
+string literals ``"FF001"``..) must appear in the rule table of
+``docs/static_analysis.md``, and conversely every FFxxx the doc table
+names must be implemented — a documented-but-deleted rule is drift too.
+Wired into tier-1 via ``tests/test_housekeeping_r9.py``.
+
+Usage: python scripts/check_docs_rules.py [RULES_PY] [DOC_MD]
+Exit status: 0 in sync, 1 otherwise (the drift is listed on stderr).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_RULES = os.path.join(_REPO, "flexflow_tpu", "analysis", "rules.py")
+DEFAULT_DOC = os.path.join(_REPO, "docs", "static_analysis.md")
+
+_ID_RE = re.compile(r'"(FF\d{3})"')
+_DOC_ID_RE = re.compile(r"\b(FF\d{3})\b")
+
+
+def rule_ids_in_source(path: str) -> set:
+    with open(path) as f:
+        src = f.read()
+    # the registry literals only: Rule("FFxxx", ...) — matches every
+    # quoted ID, which in rules.py exist only as registry keys/refs
+    return set(_ID_RE.findall(src))
+
+
+def rule_ids_in_doc(path: str) -> set:
+    with open(path) as f:
+        return set(_DOC_ID_RE.findall(f.read()))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rules_py = argv[0] if argv else DEFAULT_RULES
+    doc_md = argv[1] if len(argv) > 1 else DEFAULT_DOC
+    implemented = rule_ids_in_source(rules_py)
+    if not implemented:
+        print(f"{rules_py}: no FFxxx rule IDs found — wrong file?",
+              file=sys.stderr)
+        return 1
+    documented = rule_ids_in_doc(doc_md)
+    undocumented = sorted(implemented - documented)
+    phantom = sorted(documented - implemented)
+    if undocumented:
+        print(f"{doc_md}: {len(undocumented)} implemented rule(s) "
+              f"undocumented: {', '.join(undocumented)} — add each to the "
+              "rule table", file=sys.stderr)
+    if phantom:
+        print(f"{doc_md}: documents rule(s) not implemented in "
+              f"{rules_py}: {', '.join(phantom)}", file=sys.stderr)
+    if undocumented or phantom:
+        return 1
+    print(f"ok: all {len(implemented)} ShardLint rules documented in "
+          f"{os.path.basename(doc_md)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

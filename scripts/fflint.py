@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""fflint: the repo's lint front door — graph-level and code-level.
+
+Graph mode (default) delegates to the ShardLint CLI
+(``python -m flexflow_tpu.analysis`` — static sharding/dataflow
+verification of a parallel plan, rules FF001-FF006,
+docs/static_analysis.md):
+
+    python scripts/fflint.py --model mlp --strategy hybrid --tp 2
+    python scripts/fflint.py --model attention --inject duplicate
+
+Code mode (``--code [PATH...]``) is the code-level static gate: it runs
+**ruff** when installed, and otherwise falls back to a small built-in AST
+lint implementing the subset of rules this repo enforces everywhere even
+on tool-less machines:
+
+* ``E722``  bare ``except:`` (swallows KeyboardInterrupt/SystemExit —
+  especially dangerous around device code, where it hides XLA errors);
+* ``F401``-lite: module-level imports never referenced again in the file
+  (``__init__.py`` re-export files and ``# noqa`` lines are exempt);
+* ``B006``-lite: mutable default arguments (list/dict/set literals).
+
+Exit status: 0 clean, 1 findings. ``tests/test_housekeeping_r9.py`` runs
+code mode over ``flexflow_tpu/`` in tier-1, so regressions fail CI with
+or without ruff installed.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import subprocess
+import sys
+from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = (os.path.join(_REPO, "flexflow_tpu"),)
+
+
+def _py_files(paths) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def _noqa_lines(src: str) -> set:
+    return {i for i, line in enumerate(src.splitlines(), 1)
+            if "# noqa" in line}
+
+
+def _check_bare_except(tree, noqa) -> List[Tuple[int, str]]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and node.lineno not in noqa:
+            out.append((node.lineno,
+                        "E722 bare 'except:' (catches SystemExit/"
+                        "KeyboardInterrupt; name the exception)"))
+    return out
+
+
+def _check_mutable_defaults(tree, noqa) -> List[Tuple[int, str]]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for d in list(node.args.defaults) + \
+                [x for x in node.args.kw_defaults if x is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) and \
+                    d.lineno not in noqa:
+                out.append((d.lineno,
+                            f"B006 mutable default argument in "
+                            f"'{node.name}' (shared across calls; use "
+                            "None + init in the body)"))
+    return out
+
+
+def _check_unused_imports(tree, src, path, noqa) -> List[Tuple[int, str]]:
+    if os.path.basename(path) == "__init__.py":
+        return []  # re-export modules: unused-at-module-level is the point
+    imported = {}  # bound name -> (lineno, display)
+    for node in tree.body:  # module level only: locals are too dynamic
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                imported[name] = (node.lineno, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directive, never "used"
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name
+                imported[name] = (node.lineno, a.name)
+    if not imported:
+        return []
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the Name at the root of the chain is walked anyway
+    # names referenced in docstrings/strings (e.g. __all__) count via text
+    out = []
+    for name, (lineno, display) in imported.items():
+        if name in used or lineno in noqa:
+            continue
+        # conservative: any WORD mention outside the import line keeps it
+        # (word-boundary match — substring matching would let short names
+        # like 'os' hide inside 'those'/'cost' and never be flagged)
+        pat = re.compile(rf"\b{re.escape(name)}\b")
+        mentions = [i for i, line in enumerate(src.splitlines(), 1)
+                    if pat.search(line) and i != lineno]
+        if mentions:
+            continue
+        out.append((lineno, f"F401 '{display}' imported but unused"))
+    return out
+
+
+def lint_file(path: str) -> List[str]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    noqa = _noqa_lines(src)
+    findings: List[Tuple[int, str]] = []
+    findings += _check_bare_except(tree, noqa)
+    findings += _check_mutable_defaults(tree, noqa)
+    findings += _check_unused_imports(tree, src, path, noqa)
+    rel = os.path.relpath(path, _REPO)
+    return [f"{rel}:{ln}: {msg}" for ln, msg in sorted(findings)]
+
+
+def run_ruff(paths) -> int:
+    """Run ruff (config in pyproject.toml) when available; -1 = absent."""
+    import importlib.util
+
+    if importlib.util.find_spec("ruff") is None:
+        return -1
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check", *paths],
+            cwd=_REPO, capture_output=True, text=True)
+    except OSError:
+        return -1
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode not in (0, 1):
+        # ruff IS installed but errored (rc 2 = bad config/usage): that
+        # is a failure to surface, not tool absence — silently dropping
+        # to the weaker builtin lint would pass a broken gate
+        print(f"fflint: ruff errored (exit {proc.returncode}) — fix the "
+              "invocation/config, not falling back", file=sys.stderr)
+        return 2
+    return proc.returncode
+
+
+def code_mode(paths) -> int:
+    paths = list(paths) or list(DEFAULT_PATHS)
+    rc = run_ruff(paths)
+    if rc >= 0:
+        print(f"fflint: ruff check {'clean' if rc == 0 else 'FAILED'}")
+        return rc
+    findings: List[str] = []
+    files = _py_files(paths)
+    for path in files:
+        findings.extend(lint_file(path))
+    for line in findings:
+        print(line)
+    print(f"fflint (builtin fallback, ruff not installed): "
+          f"{len(files)} files, {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--code":
+        return code_mode(argv[1:])
+    sys.path.insert(0, _REPO)
+    from flexflow_tpu.analysis.__main__ import main as graph_main
+
+    return graph_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,6 +147,15 @@ def summarize_telemetry(data, top: int) -> None:
         if ss.get("final_strategy"):
             line += f"   final strategy: {ss['final_strategy']}"
         print(line)
+    st = data.get("strategy_static")
+    if st:
+        # ShardLint headline (ISSUE 7): static analyses run and what
+        # they rejected before any compile was paid
+        line = (f"static analysis: {st.get('checks', 0)} checks, "
+                f"{st.get('rejects', 0)} rejected")
+        if st.get("rules"):
+            line += f"   rules fired: {', '.join(st['rules'])}"
+        print(line)
     srv = data.get("serving")
     if srv:
         # serving headline (ISSUE 6): request/token volume, queue pressure

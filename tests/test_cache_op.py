@@ -3,7 +3,6 @@ src/ops/cache.cc:291 + the commented moe.cc:180,204 hooks): the executor
 threads real cache state, score_fn runs host-side, and the score feeds the
 dynamic-recompile trigger."""
 import numpy as np
-import pytest
 
 from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
 from flexflow_tpu.ffconst import ActiMode, OperatorType

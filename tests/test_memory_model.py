@@ -13,7 +13,6 @@ mem legs re-measure them live every round — keys mem_analytic_vs_xla{,_
 seq4096,_dlrm} in BENCH_r05); CPU-compiled peaks use a different buffer
 assignment and are NOT comparable, so this test validates the analytic side
 against the recorded chip numbers."""
-import numpy as np
 import pytest
 
 from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, LossType

@@ -2,7 +2,6 @@
 equivalence with the dense-dispatch formulation, all-k load-balance term,
 batched Experts op, EP in the search space, >=8-expert training."""
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

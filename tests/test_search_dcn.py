@@ -14,7 +14,7 @@ import pytest
 from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
 from flexflow_tpu.models.bert import BertConfig, build_bert
 from flexflow_tpu.search.machine_model import TPUMachineModel
-from flexflow_tpu.search.simulator import OpSharding, Simulator
+from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.unity import (dcn_placements, dp_assign,
                                        unity_search)
 

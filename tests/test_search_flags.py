@@ -5,7 +5,6 @@ at bottlenecks, substitution.cc:2095 find_split_node)."""
 import json
 
 import numpy as np
-import pytest
 
 from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType
 

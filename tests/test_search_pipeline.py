@@ -3,7 +3,6 @@ unity_search, strategy JSON round-trip, and end-to-end compile/fit/eval
 routing through PipelineTrainer (beyond the reference, which only reserves
 OP_PIPELINE)."""
 import numpy as np
-import pytest
 
 from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
                           SGDOptimizer)

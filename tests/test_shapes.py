@@ -1,9 +1,8 @@
 """Golden-shape tests per op (SURVEY §7 stage 1: port of the reference's
 hardware-free tests/unit tier plus shape checks for every builder)."""
 import numpy as np
-import pytest
 
-from flexflow_tpu import FFConfig, FFModel, DataType, ActiMode, AggrMode, PoolType
+from flexflow_tpu import FFConfig, FFModel, DataType, AggrMode
 
 
 def make_model():

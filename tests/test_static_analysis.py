@@ -1,0 +1,486 @@
+"""ShardLint (ISSUE 7, flexflow_tpu/analysis, docs/static_analysis.md):
+the placement-lattice abstract interpreter, rules FF001-FF006, cascade
+stage 0 (statically-invalid winner degrades with ZERO compile/probe
+executions), Unity-search candidate pruning, the pre-serve FF005 gate
+with its runtime backstop, the graph-level wrong-reshard chaos injection
+shared by the static and dynamic checks, and the CLI."""
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.analysis import (BufferRef, DonationSpec,
+                                   StaticAnalysisError, analyze_model,
+                                   analyze_strategy, check_donation,
+                                   check_remat, check_rng_streams,
+                                   check_serving_graph, check_shapes,
+                                   donation_spec_for_training, interpret)
+from flexflow_tpu.parallel.strategies import hybrid_data_tensor_strategy
+from flexflow_tpu.parallel.strategy import data_parallel_strategy
+from flexflow_tpu.resilience import ChaosPlan, inject_wrong_reshard
+
+BATCH = 8
+
+
+def _mlp3(ff=None):
+    """3-dense MLP (softmax head: the loss consumes probabilities) whose
+    hybrid strategy has a row-parallel middle layer — a partial-sum
+    producer with consumers, the graph-defect injection site."""
+    ff = ff or FFModel(FFConfig())
+    x = ff.create_tensor((BATCH, 16), name="x")
+    t = ff.dense(x, 32, name="d1")
+    t = ff.relu(t)
+    t = ff.dense(t, 32, name="d2")
+    t = ff.relu(t)
+    t = ff.dense(t, 10, name="d3")
+    t = ff.softmax(t, name="probs")
+    return ff
+
+
+def _pcg_and_hybrid(dp=4, tp=2):
+    ff = _mlp3()
+    pcg = ff.create_pcg()
+    return pcg, hybrid_data_tensor_strategy(pcg, dp, tp)
+
+
+def _compiled_hybrid(**cfg_kw):
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    ff = _mlp3(FFModel(cfg))
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy_fn=lambda p: hybrid_data_tensor_strategy(p, 4, 2))
+    return ff
+
+
+def _data():
+    # modest input scale: random-init logits stay in softmax's live range
+    # (saturated clipped cross-entropy has exactly-zero gradients, which
+    # would make the audit's grad-norm comparison vacuous)
+    rng = np.random.default_rng(0)
+    return (0.25 * rng.normal(size=(64, 16)).astype(np.float32),
+            rng.integers(0, 10, size=64).astype(np.int32))
+
+
+# =================================================== clean strategies
+def test_clean_strategies_zero_diagnostics():
+    """dp / tp / hybrid / pipeline / remat plans all analyze clean — the
+    zero-false-positive contract that lets the search prune on errors."""
+    for build in (
+        lambda p: data_parallel_strategy(p, 8),
+        lambda p: hybrid_data_tensor_strategy(p, 1, 2),    # pure tp
+        lambda p: hybrid_data_tensor_strategy(p, 4, 2),    # hybrid
+    ):
+        pcg = _mlp3().create_pcg()
+        rep = analyze_strategy(pcg, build(pcg))
+        assert rep.ok, rep.describe()
+    pcg = _mlp3().create_pcg()
+    s = data_parallel_strategy(pcg, 8)
+    s.pipeline = (2, 4, 4)
+    assert analyze_strategy(pcg, s).ok
+    for level in ("none", "selective", "full"):
+        pcg = _mlp3().create_pcg()
+        s = data_parallel_strategy(pcg, 8)
+        s.remat = level
+        rep = analyze_strategy(pcg, s)
+        assert rep.ok, (level, rep.describe())
+
+
+def test_searched_winner_with_parallel_ops_clean():
+    """A searched tp winner's PCG (Reduction/parallel-op nodes inserted by
+    insert_parallel_ops) analyzes clean: every partial producer is
+    matched by its Reduction."""
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.search_budget = 8
+    ff = _mlp3(FFModel(cfg))
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rep = analyze_model(ff)
+    assert rep.ok, rep.describe()
+    assert set(rep.checked) >= {"FF001", "FF002", "FF003", "FF004",
+                                "FF006"}
+
+
+# ============================================ FF001: partial-sum defects
+def test_ff001_dropped_reduction():
+    pcg, s = _pcg_and_hybrid()
+    desc = inject_wrong_reshard(pcg, s, mode="drop")
+    assert "d2" in desc
+    rep = analyze_strategy(pcg, s)
+    errs = [d for d in rep.errors if d.rule_id == "FF001"]
+    assert errs, rep.describe()
+    # the diagnostic names the producing node and speaks partial_sum
+    assert "d2" in errs[0].message and "partial_sum" in errs[0].message
+
+
+def test_ff001_doubled_reduction():
+    pcg, s = _pcg_and_hybrid()
+    desc = inject_wrong_reshard(pcg, s, mode="duplicate")
+    assert "chaos_dup_reduction" in desc
+    rep = analyze_strategy(pcg, s)
+    errs = [d for d in rep.errors if d.rule_id == "FF001"]
+    assert errs, rep.describe()
+    assert "chaos_dup_reduction" in errs[0].node
+    assert "doubled reduction" in errs[0].message
+
+
+def test_ff001_on_explicit_reduction_node():
+    """Against a graph with a REAL OP_REDUCTION IR node (the searched
+    plans' insert_parallel_ops pattern: the reducing output constraint
+    lives on the Reduction node, not the producer): the pair analyzes
+    clean; dropping the node leaves the partial unreduced; a duplicate
+    stacked on it double-reduces."""
+    from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.ops.base import op_class_for
+
+    pcg, s = _pcg_and_hybrid()
+    d2 = [n for n in pcg.compute_nodes() if n.name.startswith("d2")][0]
+    relu = pcg.consumers(d2.guid)[0]
+    op = op_class_for(OperatorType.OP_REDUCTION)(
+        f"reduction_{d2.guid}",
+        {"dim": 0, "degree": 2, "axes": ("model",)},
+        d2.op.data_type, num_inputs=1)
+    red = pcg.insert_node_on_edge(relu, 0, op)
+    # move the reducing constraint onto the Reduction node, as
+    # insert_parallel_ops does for searched winners
+    ns = s.for_node(red.guid)
+    ns.output_spec = s.node_strategies[d2.guid].output_spec
+    s.node_strategies[d2.guid].output_spec = None
+    assert analyze_strategy(pcg, s).ok
+    desc = inject_wrong_reshard(pcg, s, mode="drop")
+    assert "dropped reduction node" in desc
+    rep = analyze_strategy(pcg, s)
+    assert any(d.rule_id == "FF001" for d in rep.errors), rep.describe()
+
+
+# ====================================== FF002: donation-aliasing safety
+def test_ff002_post_step_reference_to_donated_buffer():
+    bad = DonationSpec(
+        step="train_step", donated=("params", "opt_state"),
+        post_step_refs=(BufferRef("async_checkpoint", "params",
+                                  device_copy=False),))
+    diags = check_donation(bad)
+    assert len(diags) == 1 and diags[0].rule_id == "FF002"
+    assert "donated buffer 'params'" in diags[0].message
+    # a device-side snapshot (the PR 4 fix) is safe
+    good = DonationSpec(
+        step="train_step", donated=("params", "opt_state"),
+        post_step_refs=(BufferRef("async_checkpoint", "params",
+                                  device_copy=True),))
+    assert check_donation(good) == []
+
+
+def test_ff002_live_training_contract_clean(tmp_path):
+    """The real wiring: with async checkpointing armed the retainers all
+    snapshot device-side, so the live model's contract proves clean."""
+    ff = _compiled_hybrid(checkpoint_dir=str(tmp_path), checkpoint_every=2)
+    spec = donation_spec_for_training(ff)
+    assert {r.holder for r in spec.post_step_refs} == {"CheckpointManager"}
+    assert check_donation(spec) == []
+    assert analyze_model(ff).ok
+
+
+# ========================================= FF003: rng-stream collision
+def test_ff003_duplicate_schedule_replays_stream():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((BATCH, 16), name="x")
+    t = ff.dense(x, 32, name="d1")
+    t = ff.dropout(t, rate=0.5, name="drop")
+    t = ff.dense(t, 10, name="d2")
+    pcg = ff.create_pcg()
+    assert check_rng_streams(pcg) == []
+    drop_guid = [n.guid for n in pcg.compute_nodes()
+                 if n.name.startswith("drop")][0]
+    pcg._order.append(drop_guid)  # a buggy rewrite scheduling it twice
+    diags = check_rng_streams(pcg)
+    assert len(diags) == 1 and diags[0].rule_id == "FF003"
+    assert "same guid" in diags[0].message
+
+
+# ============================================ FF004: remat segmentation
+def test_ff004_partition_and_backward_cut():
+    pcg = _mlp3().create_pcg()
+    assert check_remat(pcg, "none") == []          # no remat, no rule
+    assert check_remat(pcg, "full", 2) == []       # real segmentation OK
+    compute = [n.guid for n in pcg.compute_nodes()]
+    # a segmentation that lost a node
+    diags = check_remat(pcg, "full", segments=[compute[:-1]])
+    assert any(d.rule_id == "FF004" and "misses" in d.message
+               for d in diags)
+    # a cut running against the topological order
+    diags = check_remat(pcg, "full",
+                        segments=[compute[2:], compute[:2]])
+    assert any(d.rule_id == "FF004" and "against the topological order"
+               in d.message for d in diags)
+
+
+# ====================== FF006: preflight re-route, identical error texts
+def test_ff006_matches_preflight_error_texts():
+    from flexflow_tpu.resilience import PreflightError, preflight_strategy
+
+    pcg, s = _pcg_and_hybrid()
+    ns = s.node_strategies[[n.guid for n in pcg.compute_nodes()
+                            if n.name.startswith("d1")][0]]
+    ns.weight_specs["kernel"] = (None, "bogus")
+    diags = check_shapes(pcg, s)
+    assert diags and diags[0].rule_id == "FF006"
+    with pytest.raises(PreflightError) as ei:
+        preflight_strategy(pcg, s, n_dev=8, batch_size=BATCH)
+    # the preflight error IS the analyzer's first diagnostic message
+    assert str(ei.value) == diags[0].message
+    assert "bogus" in str(ei.value)
+
+
+def test_ff006_indivisible_dim_text():
+    from flexflow_tpu.resilience import PreflightError, preflight_strategy
+
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((BATCH, 16), name="x")
+    t = ff.dense(x, 30, name="odd")  # 30 % 4 != 0
+    pcg = ff.create_pcg()
+    s = hybrid_data_tensor_strategy(pcg, 2, 4)
+    guid = [n.guid for n in pcg.compute_nodes() if n.name.startswith("odd")][0]
+    s.node_strategies[guid].weight_specs["kernel"] = (None, "model")
+    diags = check_shapes(pcg, s)
+    assert diags and "not divisible by mesh axis 'model'" in \
+        diags[0].message
+    with pytest.raises(PreflightError, match="not divisible"):
+        preflight_strategy(pcg, s, n_dev=8, batch_size=BATCH)
+
+
+# ============================ cascade stage 0: reject without a compile
+def test_cascade_stage0_rejects_statically_with_zero_compiles():
+    """ISSUE 7 acceptance: the statically-invalid winner falls to a
+    runner-up WITHOUT any compile/probe (compile_probes counts only the
+    fallback's own verification), FF001 and the node land in the
+    diagnosis, and the strategy_static telemetry block records it."""
+    x, y = _data()
+    ff = _compiled_hybrid(audit_strategy=True)
+    winner = ff.strategy.describe()
+    ff._telemetry_requested = True
+    chaos = ChaosPlan(wrong_reshard=True, wrong_reshard_mode="duplicate")
+    ff.fit(x, y, epochs=1, chaos=chaos)
+    c = ff._last_cascade
+    assert c.static_checks == 2          # bad winner + clean fallback
+    assert c.static_rejects == 1
+    assert c.static_rules == ["FF001"]
+    # THE acceptance counter: the rejected winner never compiled; the one
+    # probe belongs to the fallback candidate that passed stage 0
+    assert c.compile_probes == 1
+    assert c.fallbacks == 1
+    assert ff.strategy.describe() != winner
+    desc, reason = c.failures[0]
+    assert desc == winner
+    assert "FF001" in reason and "chaos_dup_reduction" in reason
+    blk = ff.get_telemetry().summary()["strategy_static"]
+    assert blk == {"checks": 2, "rejects": 1, "rules": ["FF001"]}
+    # the run actually trained on the fallback
+    losses = ff.get_telemetry().summary()["loss_history"]
+    assert losses and np.isfinite(losses).all()
+
+
+def test_dynamic_audit_catches_graph_defect_when_static_off():
+    """The same concrete graph defect, judged dynamically: with
+    --static-analysis off the doubled-reduction node reaches the
+    compile/audit stages and the parallel-correctness probe diverges
+    from the single-device reference (which computes the TRUE value —
+    the injected node only scales under a multi-device mesh). This is
+    the graph-level replacement for the legacy norm-scaling simulation."""
+    x, y = _data()
+    ff = _compiled_hybrid(audit_strategy=True, static_analysis="off")
+    chaos = ChaosPlan(wrong_reshard=True, wrong_reshard_mode="duplicate",
+                      wrong_reshard_factor=4.0)
+    ff.fit(x, y, epochs=1, chaos=chaos)
+    c = ff._last_cascade
+    assert c.static_checks == 0
+    assert c.audit_failures == 1 and c.fallbacks == 1
+    assert chaos.wrong_reshards_injected == 1
+    assert "chaos_dup_reduction" in chaos.injected_defect
+    # once-semantics: the fallback candidate audited clean
+    assert c.audit_reports[-1].passed
+
+
+def test_scale_fallback_when_no_reduction_site():
+    """A pure-dp graph has no reduction to break: the graph-level
+    injection degrades to the legacy scale simulation with a warning
+    (never silently does nothing)."""
+    x, y = _data()
+    cfg_kw = dict(audit_strategy=True, only_data_parallel=True)
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    ff = _mlp3(FFModel(cfg))
+    ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    chaos = ChaosPlan(wrong_reshard=True, wrong_reshard_mode="duplicate")
+    with pytest.warns(UserWarning, match="no injection site"):
+        ff.fit(x, y, epochs=1, chaos=chaos)
+    assert chaos.wrong_reshard_mode == "scale"
+    assert ff._last_cascade.audit_failures == 1  # legacy path still fires
+
+
+# =============================== FF005: pre-serve static + runtime backstop
+def test_ff005_fused_stateful_region_static_and_backstop():
+    from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+    from flexflow_tpu.serving import ServingEngine
+
+    cfg = GPT2Config.tiny(batch_size=BATCH)
+    config = FFConfig()
+    config.batch_size = BATCH
+    config.perform_fusion = True
+    config.only_data_parallel = True
+    ff = FFModel(config)
+    build_gpt2(ff, cfg)
+    ff.compile(optimizer=SGDOptimizer(ff),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    # static: the rule flags the fused region BEFORE any engine exists
+    diags = check_serving_graph(ff.pcg)
+    assert diags and all(d.rule_id == "FF005" for d in diags)
+    # pre-serve: the engine surfaces the FF005 diagnostic
+    with pytest.raises(NotImplementedError, match="FF005"):
+        ServingEngine(ff, max_decode_len=cfg.seq_len)
+    # analysis skipped: the original runtime refusal still fires
+    ff.config.static_analysis = "off"
+    with pytest.raises(NotImplementedError, match="fusion"):
+        ServingEngine(ff, max_decode_len=cfg.seq_len)
+
+
+# ==================================== search pruning before the simulator
+def test_search_prunes_statically_invalid_candidates(tmp_path,
+                                                     monkeypatch):
+    """Candidates ShardLint rejects never reach the simulator: with a
+    monkeypatched analyzer refusing every tp>1 plan, the search settles
+    on a tp==1 winner and logs the pruned counts (SearchLog events + the
+    final record + SearchResult.pruned_static)."""
+    import flexflow_tpu.analysis as analysis
+    from flexflow_tpu.analysis.report import AnalysisReport, Diagnostic
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.unity import unity_search
+
+    real = analysis.analyze_candidate
+
+    def veto_tp(pcg, strategy):
+        if len(strategy.mesh_shape) > 1 and strategy.mesh_shape[1] > 1:
+            return AnalysisReport(diagnostics=[Diagnostic(
+                rule_id="FF001", node="test",
+                message="vetoed for the pruning test")])
+        return real(pcg, strategy)
+
+    monkeypatch.setattr(analysis, "analyze_candidate", veto_tp)
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.search_budget = 8
+    cfg.search_log_file = str(tmp_path / "search.jsonl")
+    pcg = _mlp3(FFModel(cfg)).create_pcg()
+    res = unity_search(pcg, cfg, 8,
+                       machine=TPUMachineModel.from_generation("v5e", 8),
+                       return_result=True, insert_ir_nodes=False)
+    assert res.pruned_static > 0
+    assert res.mesh_shape[1] == 1 if len(res.mesh_shape) > 1 else True
+    records = [json.loads(line) for line in
+               (tmp_path / "search.jsonl").read_text().splitlines()]
+    pruned = [r for r in records if r.get("event") == "pruned_static"]
+    assert len(pruned) == res.pruned_static
+    assert pruned[0]["rules"] == ["FF001"]
+    final = [r for r in records if r.get("event") == "result"][-1]
+    assert final["pruned_static"] == res.pruned_static
+    # no pruned candidate was simulated as a "candidate" record at tp>1
+    cands = [r for r in records if r.get("event") == "candidate"]
+    assert all(r["tp"] == 1 for r in cands)
+
+
+def test_search_clean_run_prunes_nothing(tmp_path):
+    """Well-formed candidates are untouched: the real analyzer prunes
+    zero candidates on a plain search (the winner is bit-identical to a
+    run with analysis off)."""
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.unity import unity_search
+
+    def run(static):
+        cfg = FFConfig()
+        cfg.batch_size = BATCH
+        cfg.search_budget = 8
+        cfg.static_analysis = static
+        pcg = _mlp3(FFModel(cfg)).create_pcg()
+        return unity_search(
+            pcg, cfg, 8,
+            machine=TPUMachineModel.from_generation("v5e", 8),
+            return_result=True, insert_ir_nodes=False)
+    on, off = run("on"), run("off")
+    assert on.pruned_static == 0
+    assert tuple(on.mesh_shape) == tuple(off.mesh_shape)
+    assert on.sim_time == off.sim_time
+
+
+# ============================================= strict mode + CLI + digest
+def test_strict_compile_rejects_broken_strategy():
+    cfg = FFConfig()
+    cfg.batch_size = BATCH
+    cfg.static_analysis = "strict"
+    ff = _mlp3(FFModel(cfg))
+
+    def broken(pcg):
+        s = hybrid_data_tensor_strategy(pcg, 4, 2)
+        inject_wrong_reshard(pcg, s, mode="drop")
+        return s
+
+    with pytest.raises(StaticAnalysisError, match="FF001"):
+        ff.compile(optimizer=SGDOptimizer(ff, lr=0.05),
+                   loss_type=LossType.
+                   LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   strategy_fn=broken)
+
+
+def test_cli_clean_and_injected(capsys):
+    from flexflow_tpu.analysis.__main__ import main as cli
+
+    assert cli(["--model", "mlp", "--strategy", "hybrid", "--tp", "2"]) \
+        == 0
+    out = capsys.readouterr().out
+    assert "clean" in out and "FF001" in out  # rules-checked footer
+    assert cli(["--model", "attention", "--strategy", "hybrid",
+                "--inject", "duplicate"]) == 1
+    out = capsys.readouterr().out
+    assert "FF001" in out and "[fix:" in out and "FAIL" in out
+    # JSON mode is machine-readable
+    assert cli(["--model", "mlp", "--strategy", "dp", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["errors"] == 0 and "FF001" in " ".join(data["checked"])
+
+
+def test_placement_lattice_row_parallel_partial():
+    """White-box: the interpreter sees the row-parallel middle layer's
+    partial_sum arise and be discharged by its output constraint."""
+    pcg, s = _pcg_and_hybrid()
+    d2 = [n for n in pcg.compute_nodes() if n.name.startswith("d2")][0]
+    values = interpret(pcg, s).values
+    # discharged at the node (output_spec) — downstream is batch-sharded
+    assert not values[(d2.guid, 0)].is_partial
+    # strip the constraint: the partial now flows
+    s.node_strategies[d2.guid].output_spec = None
+    values = interpret(pcg, s).values
+    assert values[(d2.guid, 0)].partial == frozenset({"model"})
+
+
+def test_trace_summary_prints_static_digest(tmp_path, capsys):
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "scripts"))
+    import trace_summary
+
+    tf = tmp_path / "telemetry.json"
+    tf.write_text(json.dumps({
+        "phase": "train", "steps": 4, "batch_size": 8,
+        "strategy_static": {"checks": 2, "rejects": 1,
+                            "rules": ["FF001"]}}))
+    trace_summary.main([str(tf)])
+    out = capsys.readouterr().out
+    assert "static analysis: 2 checks, 1 rejected" in out
+    assert "FF001" in out
