@@ -189,6 +189,8 @@ def main():
                                                   jrandom.PRNGKey(1 + i))
             _ = float(loss)
             dt = (time.perf_counter() - t0) / 3
+            # donation writeback: keep ff.params live for calibration_leg
+            ff.params, ff.opt_state = params, opt_state
 
     samples_per_sec = cfg.batch_size / dt
     flops_per_step = bert_train_flops_per_step(cfg)
@@ -207,6 +209,11 @@ def main():
         "model_flops_per_step": flops_per_step,
         "retries_attempted": retries_attempted,
     }
+    # closed-loop recalibration anchor (ISSUE 8): runs on BOTH tiers —
+    # the drift trajectory VERDICT.md hand-computed across rounds is now a
+    # tracked BENCH metric (CPU-sim tier included so every round records it)
+    with tracer.span("calibration_leg"):
+        result.update(calibration_leg(ff, xd))
     if on_tpu:
         legs = [("cost_model_checks",
                  lambda: cost_model_checks(ff, config, dt,
@@ -353,6 +360,10 @@ def _time_step(ff, xd, yd, warmup: int = 3) -> float:
             windows.append((time.perf_counter() - t0) / iters)
         medians.append(sorted(windows)[1])
     t_n, t_2n = medians
+    # the step donates its params/opt_state buffers: write the advanced
+    # state back so ff.params is live for later legs (calibration_leg
+    # profiles the model in place — a deleted-buffer crash otherwise)
+    ff.params, ff.opt_state = params, opt_state
     # guards: the true step is at most t(2n) (RTT >= 0); noise can also
     # push the extrapolation absurdly low — floor it at half of t(2n)
     return min(max(2 * t_2n - t_n, 0.5 * t_2n), t_2n)
@@ -496,6 +507,51 @@ def serving_leg() -> dict:
                 plan.sim_tokens_per_s / naive[0].sim_tokens_per_s, 3)
     except Exception as e:
         out["serving_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def calibration_leg(ff, xd) -> dict:
+    """Closed-loop recalibration anchor (ISSUE 8, docs/calibration.md):
+    one ProfiledStep pass over the live BERT graph (per-op on-device
+    timings joined to the simulator's op-cost keys), the aggregate
+    sim-vs-measured ratio BEFORE repair — the drift trajectory VERDICT.md
+    flagged at 1.271x and hand-tracked across rounds — then
+    ``calibrate_from_profile`` folds the measurements back and the AFTER
+    ratio shows the repaired ruler. Also counts how selective the
+    delta-cost invalidation was."""
+    import jax
+
+    from flexflow_tpu.obs.drift import DriftSentinel
+    from flexflow_tpu.obs.profile import OpProfile, profile_model
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import Simulator
+
+    sim = Simulator(TPUMachineModel.detect(len(jax.devices())))
+    # the VERDICT.md sim_vs_measured series has always judged a
+    # CALIBRATED ruler (_sim_vs_measured runs calibrate_from_pcg first) —
+    # an uncalibrated "before" would measure raw roofline error, a
+    # different, incomparable quantity (~300x on the CPU tier)
+    sim.calibrate_from_pcg(ff.pcg, max_ops=16)
+    records = profile_model(ff, xd, iters=3, sim=sim)
+    sentinel = DriftSentinel(sim, ff.pcg)
+    before = sentinel.ratios(records)["aggregate_ratio"]
+    rep = sim.calibrate_from_profile(OpProfile(records), ff.pcg)
+    after = sentinel.ratios(records)["aggregate_ratio"]
+    out = {
+        "calibration_keys_profiled": len(records),
+        "calibration_keys_updated": rep["updated"],
+        "calibration_cost_entries_invalidated":
+            rep["invalidated"]["cost_entries"],
+    }
+    # the sentinel's ratio convention is measured/predicted; BENCH's
+    # sim_vs_measured trajectory has always been predicted/measured —
+    # invert so the new keys continue the VERDICT.md series
+    if before:
+        out["calibration_sim_vs_measured_before"] = round(1.0 / before, 4)
+    if after:
+        out["calibration_sim_vs_measured_after"] = round(1.0 / after, 4)
+        out["calibration_repaired_within_25pct"] = bool(
+            1 / 1.25 <= after <= 1.25)
     return out
 
 

@@ -170,6 +170,27 @@ class FFConfig:
     # dynamic checks only (the pre-ISSUE 7 behavior).
     static_analysis: str = "on"
 
+    # closed-loop calibration (flexflow_tpu/obs/drift.py +
+    # search/calibration.py, docs/calibration.md; ISSUE 8).
+    # --profile-ops PATH arms the ProfiledStep pass: fit() times every
+    # distinct op shape on device, streams OpRecords to PATH (JSONL) and
+    # feeds the drift sentinel (sim-vs-measured per op-cost cache key)
+    profile_ops: str = ""
+    # drift band half-width: a key whose rolling measured/predicted ratio
+    # leaves [1/(1+tol), 1+tol] raises calibration_drift events and counts
+    # in the telemetry "calibration" block
+    drift_tolerance: float = 0.25
+    # opt-in closed loop: out-of-band drift triggers
+    # Simulator.calibrate_from_profile (per-key repair, exact delta-cost
+    # cache invalidation), table persistence, and a top-K re-rank
+    auto_recalibrate: bool = False
+    # replay a --profile-ops JSONL into the search simulator's calibration
+    # before searching (and into the fit sentinel's sim)
+    calibrate_from_trace: str = ""
+    # persistent calibration store: one JSON table per (chip generation,
+    # compute dtype), merged across runs so a fleet shares measurements
+    calibration_dir: str = ""
+
     # serving engine (flexflow_tpu/serving, docs/serving.md; ISSUE 6).
     # The reference's only inference artifact is an incomplete Triton
     # prototype — these knobs drive the JAX serving path instead.
@@ -337,6 +358,16 @@ class FFConfig:
                         f"--static-analysis expects on|off|strict, got "
                         f"{v!r}")
                 self.static_analysis = v
+            elif a == "--profile-ops":
+                self.profile_ops = _next()
+            elif a == "--drift-tolerance":
+                self.drift_tolerance = float(_next())
+            elif a == "--auto-recalibrate":
+                self.auto_recalibrate = True
+            elif a == "--calibrate-from-trace":
+                self.calibrate_from_trace = _next()
+            elif a == "--calibration-dir":
+                self.calibration_dir = _next()
             elif a == "--serve":
                 self.serve = True
             elif a == "--max-decode-len":
@@ -417,6 +448,28 @@ class FFConfig:
             raise ValueError(
                 f"--slo-p99-ms must be >= 0 (got {self.slo_p99_ms}); "
                 "0 disables the latency bound")
+        if "--drift-tolerance" in seen and self.drift_tolerance <= 0:
+            raise ValueError(
+                f"--drift-tolerance must be > 0 (got "
+                f"{self.drift_tolerance}): it is the half-width of the "
+                "sim-vs-measured band [1/(1+tol), 1+tol] the drift "
+                "sentinel alerts on")
+        if "--drift-tolerance" in seen and not (self.profile_ops or
+                                                self.auto_recalibrate):
+            raise ValueError(
+                "--drift-tolerance is only meaningful with --profile-ops "
+                "(the drift sentinel judges profiled passes); add "
+                "--profile-ops PATH or drop --drift-tolerance")
+        if "--auto-recalibrate" in seen and not self.profile_ops:
+            raise ValueError(
+                "--auto-recalibrate needs --profile-ops PATH: the closed "
+                "loop repairs calibration from the profiled pass's "
+                "measurements")
+        if "--calibrate-from-trace" in seen and \
+                not os.path.isfile(self.calibrate_from_trace):
+            raise ValueError(
+                f"--calibrate-from-trace {self.calibrate_from_trace!r}: "
+                "no such profile file (produce one with --profile-ops)")
         if "--resume" in seen:
             if self.resume == "auto" and not self.checkpoint_dir:
                 raise ValueError(

@@ -521,6 +521,118 @@ class Executor:
         self._probe_step = jax.jit(probe)
         return self._probe_step
 
+    def profile_ops(self, params, xs, iters: int = 3):
+        """ProfiledStep mode (ISSUE 8, docs/calibration.md): execute the
+        graph node by node, each node through its own jitted function
+        (per-op ``jax.named_scope`` preserved — the spans land in xprof
+        timelines too), and time each DISTINCT op shape on device:
+        block-until-ready per node, best-of-``iters`` repeats, with the
+        jit dispatch overhead (measured once on an identity jit)
+        subtracted — the same protocol as the Simulator's standalone
+        microbench, but over the LIVE graph with the LIVE weights and
+        batch, so the timings reflect the step the loop actually runs.
+
+        Returns one raw record per distinct ``(op params, in-shapes)``
+        key: ``{guid, name, op_type, in_shapes, measured_fwd_s, count}``
+        (``guid`` is the first node carrying the key; ``count`` how many
+        share it — BERT's 24 identical layers yield ONE timed record).
+        ``obs.profile.profile_model`` joins these with the live sharding
+        assignment into serializable OpRecords."""
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        params_c, xs_c = self._cast_for_compute(params, list(xs))
+        mesh = self.mesh
+        profiling = bool(getattr(self.config, "profiling", False))
+        ctx = OpContext(training=False, rng=None, mesh=mesh,
+                        profiling=profiling)
+
+        def timed(fn, *args):
+            out = fn(*args)  # warmup: compile + settle
+            jax.block_until_ready(out)
+            best = float("inf")
+            for _ in range(max(iters, 1)):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
+            return out, best
+
+        ident = jax.jit(lambda t: t * 1.000001)
+        _, overhead = timed(ident, jnp.ones((8, 8), jnp.float32))
+
+        bound = self._bind_inputs(list(xs_c))
+        values: Dict[int, List[Any]] = {}
+        timings: Dict[Tuple, Optional[Dict[str, Any]]] = {}
+        fns: Dict[Tuple, Any] = {}
+        # liveness-based freeing: the node-by-node pass would otherwise
+        # hold EVERY activation at once (the jitted step lets XLA free
+        # intermediates; remat shrinks residency further) — a model sized
+        # near HBM would OOM in the very pass meant to profile it. Drop a
+        # producer's outputs once its last consumer has run.
+        order = self.pcg.topo_order()
+        uses: Dict[int, int] = {}
+        for node in order:
+            for g, _i in node.inputs:
+                uses[g] = uses.get(g, 0) + 1
+        for node in order:
+            if node.op.op_type in (OperatorType.OP_INPUT,
+                                   OperatorType.OP_WEIGHT):
+                values[node.guid] = [bound[node.guid]]
+                continue
+            inputs = [values[g][i] for g, i in node.inputs]
+            in_shapes = tuple(map(tuple, self._node_input_shapes(node)))
+            key = (node.op.params_key(), in_shapes)
+            node_params = params_c.get(node.name, {})
+
+            def make_fn(node=node):
+                def f(np_, ins):
+                    return self._exec_node(node, np_, ins, ctx)
+                return jax.jit(f)
+
+            # one compile per distinct key: duplicate-key nodes (BERT's 24
+            # identical layers) reuse the first node's jitted fn — their
+            # op math is identical and ctx carries no rng to fold, so only
+            # the named_scope label (cosmetic here) would differ; a fresh
+            # closure per node would retrace+recompile every one
+            fn = fns.get(key)
+            if fn is None:
+                fn = fns[key] = make_fn()
+            rec = timings.get(key)
+            if rec is None and node.op.op_type == OperatorType.OP_DROPOUT:
+                # training-gated: the inference-mode forward is identity,
+                # so a timing here would measure dispatch overhead and the
+                # closed loop would slam the key's calibration to the
+                # floor — execute for consumers, never emit a record
+                # (backward ratios likewise stay on calibrate_from_pcg's
+                # training-semantics measurement)
+                rec = timings[key] = None
+            if rec is None and key in timings:
+                outs = fn(node_params, inputs)
+            elif rec is None:
+                outs, best = timed(fn, node_params, inputs)
+                timings[key] = {
+                    "guid": node.guid, "name": node.name,
+                    "op_type": node.op.op_type.name,
+                    "in_shapes": in_shapes,
+                    "measured_fwd_s": max(best - overhead, 1e-9),
+                    "count": 1,
+                }
+            else:
+                # identical key: execute (values feed consumers) without
+                # re-timing — the record just counts the extra occurrence
+                outs = fn(node_params, inputs)
+                rec["count"] += 1
+            values[node.guid] = outs
+            for g, _i in node.inputs:
+                uses[g] -= 1
+                if not uses[g]:
+                    values.pop(g, None)
+        jax.block_until_ready([values[g] for g in values])
+        return [r for r in timings.values() if r is not None]
+
     def train_step_memory_analysis(self, params, opt_state, xs, labels):
         """XLA's compiled memory stats for the full training step
         (jax.stages.Compiled.memory_analysis) — the ground truth the

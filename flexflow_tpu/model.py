@@ -835,6 +835,15 @@ class FFModel:
             # when the winner fails to compile / OOMs / fails the audit
             self._search_result = res
             self._strategy_candidates = list(res.ranked)
+            # warm search simulator (ISSUE 8): the drift sentinel's closed
+            # loop repairs THIS ruler (selective delta-cost invalidation)
+            # and an elastic restart reuses its memoized tables. A new
+            # search ruler obsoletes any cached sentinel sim/history from
+            # an earlier compile — the loop must repair the sim that
+            # ranked the LIVE plan, not a predecessor's
+            self._search_sim = res.sim
+            self._calibration_sim = None
+            self._drift_sentinel = None
             return res.strategy
         return res  # search found nothing: plain data-parallel Strategy
 
@@ -968,6 +977,18 @@ class FFModel:
         if self.config.profiling:
             self.profile_operators()
             t0 = time.time()  # per-op measurement must not skew THROUGHPUT
+        # closed-loop calibration (ISSUE 8, docs/calibration.md): with
+        # --profile-ops, ONE ProfiledStep pass per fit times every distinct
+        # op shape on device, streams OpRecords to the JSONL profile +
+        # tracer, feeds the drift sentinel, and (with --auto-recalibrate)
+        # repairs the simulator's per-key calibration in place. A plain fit
+        # pays one getattr.
+        from .obs.drift import CalibrationLoop
+
+        calib = CalibrationLoop.maybe_create(self)
+        if calib is not None:
+            calib.run_pass(xs, batch_size, telemetry, step=step_count)
+            t0 = time.time()  # profiled pass must not skew THROUGHPUT
         # Legion Prof analog (-lg:prof_logfile): XLA trace of the whole loop,
         # viewable in TensorBoard/Perfetto (SURVEY §5 tracing subsystem)
         tracing = bool(self.config.profiler_trace_dir)

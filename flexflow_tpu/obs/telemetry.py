@@ -106,6 +106,20 @@ class StepTelemetry:
         self.static_checks: int = 0
         self.static_rejects: int = 0
         self.static_rules: List[str] = []
+        # calibration counters (ISSUE 8): filled by the fit loop's
+        # CalibrationLoop after each ProfiledStep pass — profiled key
+        # count, sim-vs-measured aggregate/worst ratios, keys outside the
+        # --drift-tolerance band, recalibrations applied (with the exact
+        # delta-cost cache invalidation count) and the post-repair ratio
+        self.calib_profiled_keys: int = 0
+        self.calib_aggregate_ratio: Optional[float] = None
+        self.calib_worst_key: Optional[str] = None
+        self.calib_worst_ratio: Optional[float] = None
+        self.calib_out_of_band: int = 0
+        self.calib_tolerance: Optional[float] = None
+        self.calib_recalibrations: int = 0
+        self.calib_invalidated: int = 0
+        self.calib_ratio_after: Optional[float] = None
         # serving counters (ISSUE 6): filled by the ServingEngine after a
         # serve() run — requests completed, tokens emitted, the bounded
         # admission queue's high-water mark and the per-token latency
@@ -220,6 +234,24 @@ class StepTelemetry:
                 "rejects": self.static_rejects,
                 "rules": list(self.static_rules),
             }
+        if self.calib_profiled_keys:
+            cal: Dict[str, Any] = {
+                "profiled_keys": self.calib_profiled_keys,
+                "out_of_band": self.calib_out_of_band,
+                "recalibrations": self.calib_recalibrations,
+                "invalidated_entries": self.calib_invalidated,
+            }
+            if self.calib_aggregate_ratio is not None:
+                cal["aggregate_ratio"] = round(self.calib_aggregate_ratio, 4)
+            if self.calib_worst_key is not None:
+                cal["worst_key"] = self.calib_worst_key
+            if self.calib_worst_ratio is not None:
+                cal["worst_ratio"] = round(self.calib_worst_ratio, 4)
+            if self.calib_tolerance is not None:
+                cal["tolerance"] = self.calib_tolerance
+            if self.calib_ratio_after is not None:
+                cal["ratio_after"] = round(self.calib_ratio_after, 4)
+            out["calibration"] = cal
         if self.requests_served or self.tokens_generated:
             sv: Dict[str, Any] = {
                 "requests_served": self.requests_served,

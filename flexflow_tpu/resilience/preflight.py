@@ -30,6 +30,7 @@ says what to change. See ``docs/strategy_safety.md``.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -77,6 +78,22 @@ def preflight_config(config) -> None:
     if sa not in ("on", "off", "strict"):
         raise PreflightError(
             f"--static-analysis expects on|off|strict, got {sa!r}")
+    dt = getattr(config, "drift_tolerance", 0.25)
+    if dt is not None and float(dt) <= 0:
+        raise PreflightError(
+            f"--drift-tolerance must be > 0 (got {dt}): it is the "
+            "half-width of the sim-vs-measured band the drift sentinel "
+            "alerts on")
+    if getattr(config, "auto_recalibrate", False) and \
+            not getattr(config, "profile_ops", ""):
+        raise PreflightError(
+            "--auto-recalibrate needs --profile-ops PATH: the closed loop "
+            "repairs calibration from the profiled pass's measurements")
+    trace = (getattr(config, "calibrate_from_trace", "") or "")
+    if trace and not os.path.isfile(trace):
+        raise PreflightError(
+            f"--calibrate-from-trace {trace!r}: no such profile file "
+            "(produce one with --profile-ops)")
 
 
 # --------------------------------------------------------------- strategy

@@ -220,7 +220,9 @@ class Simulator:
 
     def __init__(self, machine: TPUMachineModel,
                  overlap_backward_update: bool = False,
-                 cost_cache_size: int = 1 << 17):
+                 cost_cache_size: int = 1 << 17,
+                 calibration_dir: Optional[str] = None,
+                 dtype_label: Optional[str] = None):
         self.machine = machine
         self.overlap = overlap_backward_update
         self._measure_cache: Dict[Tuple, float] = {}
@@ -245,6 +247,20 @@ class Simulator:
         # cost cache of simulator.cc:489; here per op-shape, scaled
         # analytically across shardings)
         self._key_calibration: Dict[Tuple, float] = {}
+        # persistent calibration tables (ISSUE 8, docs/calibration.md):
+        # repr(op key) -> {"calibration": r, "bwd_ratio": b} loaded from the
+        # per-(chip generation, dtype) JSON store under --calibration-dir
+        # and adopted lazily the first time a key is priced (repr() of the
+        # key stays off the memoized hot path; op_cost's LRU bounds how
+        # often the uncached path runs)
+        self.calibration_dir = calibration_dir
+        self.dtype_label = dtype_label or "f32"
+        self._persisted_calibration: Dict[str, Dict] = {}
+        self._persist_checked: Set[Tuple] = set()
+        if calibration_dir:
+            from .calibration import load_persistent_calibration
+
+            load_persistent_calibration(self)
         # per-op-key MEASURED backward/forward ratios (reference times
         # backward explicitly: inner_measure_operator_cost runs both
         # directions, simulator.cc:537 / model.cu:38). Keys absent here
@@ -377,6 +393,48 @@ class Simulator:
         self._table_cache.clear()
         self._reshard_cache.clear()
 
+    def _adopt_persisted(self, key: Tuple) -> float:
+        """Lazy adoption of a persisted calibration entry for ``key``
+        (ISSUE 8): the JSON store is repr-keyed, so the string lookup
+        happens at most once per distinct key on the UNCACHED path; a hit
+        installs the ratio (and measured bwd/fwd ratio, when stored) into
+        the in-memory per-key maps."""
+        if not self._persisted_calibration or key in self._persist_checked:
+            return self.calibration
+        self._persist_checked.add(key)
+        ent = self._persisted_calibration.get(repr(key))
+        if ent is None:
+            return self.calibration
+        cal = float(ent.get("calibration", self.calibration))
+        self._key_calibration[key] = cal
+        b = ent.get("bwd_ratio")
+        if b is not None:
+            self._key_bwd_ratio.setdefault(key, float(b))
+        return cal
+
+    def invalidate_op_keys(self, op_keys) -> Dict[str, int]:
+        """Selective delta-cost invalidation (ISSUE 8): drop exactly the
+        memoized entries whose ``(op params, in-shapes)`` key is in
+        ``op_keys`` — every cached CostMetrics for that key at ANY
+        sharding/dcn, and every per-node DP option table built over it —
+        leaving the rest of the caches warm (the whole point of per-key
+        recalibration vs the knob setters' full flush). The resharding
+        memo is untouched: it is a pure machine-model quantity with no
+        per-key calibration term. Under ``FLEXFLOW_TPU_SEARCH_SELFCHECK``
+        any entry this SHOULD have dropped but didn't is caught by the
+        hit-re-derivation gate in ``op_cost``. Returns removal counts."""
+        op_keys = set(op_keys)
+        stale_cost = [k for k in self._cost_cache
+                      if (k[0], k[1]) in op_keys]
+        for k in stale_cost:
+            del self._cost_cache[k]
+        stale_table = [k for k in self._table_cache
+                       if len(k) >= 3 and (k[1], k[2]) in op_keys]
+        for k in stale_table:
+            del self._table_cache[k]
+        return {"cost_entries": len(stale_cost),
+                "table_entries": len(stale_table)}
+
     def table_get(self, key: Tuple):
         """Look up an opaque per-node cost table (the DP search's per-node
         option entries) in the bounded LRU; None on miss."""
@@ -460,7 +518,9 @@ class Simulator:
             compute = shard_flops / (m.peak_flops_f32 * m.matmul_efficiency)
         mem_time = shard_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
         key = self._op_key(node, in_shapes)
-        cal = self._key_calibration.get(key, self.calibration)
+        cal = self._key_calibration.get(key)
+        if cal is None:
+            cal = self._adopt_persisted(key)
         fwd = max(compute, mem_time) * cal + self.op_overhead
         # backward: measured per-key ratio when calibrated on device
         # (calibrate_from_pcg times value_and_grad standalone); analytical
@@ -864,6 +924,79 @@ class Simulator:
                         max((tg - t) / t, 0.25), 4.0)
         self.invalidate_cost_tables()
         return measured
+
+    def calibrate_from_profile(self, profile, pcg: PCG,
+                               min_rel_change: float = 0.05
+                               ) -> Dict[str, Any]:
+        """Fold MEASURED per-op timings (an ``obs.profile.OpProfile`` —
+        the ProfiledStep pass of a live fit, or a ``--profile-ops`` JSONL
+        replayed via ``--calibrate-from-trace``) back into the per-key
+        calibration, closing the loop the PR 1 tracer opened (ISSUE 8,
+        ROADMAP item 2): records join the graph on
+        ``repr(_op_key(node, in_shapes))`` — the SAME signature the
+        op-cost cache is keyed by — and each matched key's ratio is
+        re-derived from the measurement at the record's own sharding/dcn.
+
+        Only keys whose calibration moves by more than ``min_rel_change``
+        (relative) are updated, and ONLY their delta-cost cache entries
+        are invalidated (``invalidate_op_keys`` — no full flush; the
+        selfcheck env gate re-derives every later hit, so a stale entry
+        cannot survive unnoticed). Returns ``{matched, updated,
+        invalidated, updates}``; ``updates`` lists
+        ``(key_repr, old_cal, new_cal)``."""
+        records = getattr(profile, "latest_by_key", None)
+        by_key = (records() if records is not None
+                  else {r.key: r for r in profile})
+        node_map: Dict[str, Tuple[PCGNode, List, Tuple]] = {}
+        for node in pcg.compute_nodes():
+            in_shapes = [pcg.nodes[g].out_shapes[i] for g, i in node.inputs]
+            k = self._op_key(node, in_shapes)
+            node_map.setdefault(repr(k), (node, in_shapes, k))
+        matched = 0
+        moved: Dict[Tuple, Tuple[float, float]] = {}
+        updates = []
+        for krepr, rec in by_key.items():
+            ent = node_map.get(krepr)
+            if ent is None:
+                continue
+            node, in_shapes, key = ent
+            matched += 1
+            sh_d = dict(rec.sharding or {})
+            sh = OpSharding(
+                dp=int(sh_d.get("dp", 1)), tp=int(sh_d.get("tp", 1)),
+                kind=str(sh_d.get("kind", "none")),
+                act_tp=int(sh_d.get("act_tp", 1)),
+                remat=str(sh_d.get("remat", "none")))
+            old_dcn = (self.dp_dcn, self.tp_dcn)
+            self.set_axis_topology(*(rec.dcn or (1, 1)))
+            try:
+                predicted = self.op_cost(node, in_shapes, sh).forward_time
+            finally:
+                self.set_axis_topology(*old_dcn)
+            cal_old = self._key_calibration.get(key, self.calibration)
+            roofline = (predicted - self.op_overhead) / max(cal_old, 1e-12)
+            t = float(rec.measured_fwd_s)
+            if roofline <= 0 or t <= 0:
+                continue
+            cal_new = max(t - self.op_overhead, 0.1 * t) / roofline
+            if abs(cal_new - cal_old) <= min_rel_change * \
+                    max(abs(cal_old), 1e-12):
+                continue
+            self._key_calibration[key] = cal_new
+            moved[key] = (cal_old, cal_new)
+            updates.append((krepr, cal_old, cal_new))
+        inval = (self.invalidate_op_keys(moved)
+                 if moved else {"cost_entries": 0, "table_entries": 0})
+        from ..obs import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled and moved:
+            tracer.event(
+                "calibration_applied", matched=matched, updated=len(moved),
+                cost_entries_invalidated=inval["cost_entries"],
+                table_entries_invalidated=inval["table_entries"])
+        return {"matched": matched, "updated": len(moved),
+                "invalidated": inval, "updates": updates}
 
     def measure_operator_cost(self, node: PCGNode,
                               in_shapes: List[Tuple[int, ...]],

@@ -161,6 +161,11 @@ class SearchResult:
     # candidates ShardLint rejected before simulation (ISSUE 7): free
     # rejections — none of these paid an op_cost/simulate call
     pruned_static: int = 0
+    # the WARM simulator that priced this search (ISSUE 8): the drift
+    # sentinel's closed loop repairs THIS ruler in place (selective
+    # delta-cost invalidation) and re-ranks `ranked` with its hot tables;
+    # an elastic restart hands it back in for cache reuse
+    sim: Optional[Simulator] = dataclasses.field(default=None, repr=False)
 
 
 def dcn_placements(dp: int, tp: int, num_hosts: int
@@ -1295,7 +1300,12 @@ def unity_search(pcg: PCG, config, n_dev: int,
         else:
             machine = TPUMachineModel.detect(n_dev)
     if sim is None:
-        sim = Simulator(machine, config.search_overlap_backward_update)
+        from .calibration import dtype_label
+
+        sim = Simulator(machine, config.search_overlap_backward_update,
+                        calibration_dir=getattr(config, "calibration_dir",
+                                                "") or None,
+                        dtype_label=dtype_label(config))
     # the simulator must price full-remat blocks at the SAME size the
     # Executor will cut them (execution/remat.py's one-segmentation rule)
     sim.remat_segment_size = int(
@@ -1303,6 +1313,16 @@ def unity_search(pcg: PCG, config, n_dev: int,
     if calibrate:
         n_measured = sim.calibrate_from_pcg(pcg)
         _log.info("calibrated %d op shapes on device", n_measured)
+    # --calibrate-from-trace (ISSUE 8, docs/calibration.md): replay a
+    # --profile-ops JSONL into the per-key calibration BEFORE ranking, so
+    # the search prices candidates with the measured ruler
+    trace_path = getattr(config, "calibrate_from_trace", "") or ""
+    if trace_path:
+        from .calibration import calibrate_sim_from_trace
+
+        rep = calibrate_sim_from_trace(sim, pcg, trace_path)
+        _log.info("calibrated from trace %s: %d keys matched, %d updated",
+                  trace_path, rep["matched"], rep["updated"])
 
     xfers = _load_xfers(config)
     # monotonic rewrites (activation fusion) apply greedily up front — one
@@ -1636,6 +1656,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
         insert_parallel_ops(pcg, best.assignment, best.states, best.strategy,
                             sim, dp, tp)
         sim.set_axis_topology(1, 1)
+    best.sim = sim
     return (best if return_result else best.strategy)
 
 

@@ -101,6 +101,22 @@ def summarize_trace(data, top: int) -> None:
         print(f"\n{n_instant} instant events (not aggregated)")
 
 
+def _block(data, key, render) -> None:
+    """Render one telemetry block defensively: telemetry files and this
+    summary evolve in different PRs, so an older (or newer) file may hold
+    a block shaped differently than this renderer expects. A malformed
+    block degrades to a one-line notice instead of a traceback — the rest
+    of the summary still prints and the exit stays 0."""
+    blk = data.get(key)
+    if not blk:
+        return
+    try:
+        render(blk)
+    except (TypeError, KeyError, ValueError, IndexError, AttributeError):
+        print(f"note: telemetry block {key!r} does not match this "
+              "summary's schema (file from another PR?) — skipped")
+
+
 def summarize_telemetry(data, top: int) -> None:
     if "epochs" in data:  # keras TelemetryCallback: one summary per epoch
         eps = data["epochs"]
@@ -110,24 +126,29 @@ def summarize_telemetry(data, top: int) -> None:
         return
     print(f"phase: {data.get('phase')}  steps: {data.get('steps')}  "
           f"batch_size: {data.get('batch_size')}")
-    if "first_step_s" in data:
-        line = f"first step (jit compile): {data['first_step_s'] * 1e3:.1f} ms"
+
+    def _steps(first):
+        line = f"first step (jit compile): {first * 1e3:.1f} ms"
         if "steady_step_s" in data:
             line += (f"   steady step: {data['steady_step_s'] * 1e3:.3f} ms"
                      f"   compile overhead: "
                      f"{data.get('compile_overhead_s', 0) * 1e3:.1f} ms")
         print(line)
+
+    _block(data, "first_step_s", _steps)
     if "samples_per_sec" in data:
         print(f"throughput: {data['samples_per_sec']} samples/s")
     if "estimated_mfu" in data:
         print(f"estimated MFU: {data['estimated_mfu']}")
-    mem = data.get("device_memory")
-    if mem:
+
+    def _mem(mem):
         peak = mem.get("peak_memory_in_bytes")
         if peak:
             print(f"XLA peak memory: {peak / 2 ** 20:.1f} MiB")
-    res = data.get("resilience")
-    if res:
+
+    _block(data, "device_memory", _mem)
+
+    def _res(res):
         # fault-tolerance headline (ISSUE 4): how eventful the run was and
         # where it last picked itself back up
         line = (f"faults: {res.get('fault_events', 0)} "
@@ -137,8 +158,10 @@ def summarize_telemetry(data, top: int) -> None:
         if res.get("last_resume_step") is not None:
             line += f"   last resume at step {res['last_resume_step']}"
         print(line)
-    ss = data.get("strategy_safety")
-    if ss:
+
+    _block(data, "resilience", _res)
+
+    def _ss(ss):
         # strategy-safety headline (ISSUE 5): did the plan survive its
         # verification, and which strategy did the run actually train under
         line = (f"strategy fallbacks: {ss.get('fallbacks', 0)}   "
@@ -147,8 +170,10 @@ def summarize_telemetry(data, top: int) -> None:
         if ss.get("final_strategy"):
             line += f"   final strategy: {ss['final_strategy']}"
         print(line)
-    st = data.get("strategy_static")
-    if st:
+
+    _block(data, "strategy_safety", _ss)
+
+    def _st(st):
         # ShardLint headline (ISSUE 7): static analyses run and what
         # they rejected before any compile was paid
         line = (f"static analysis: {st.get('checks', 0)} checks, "
@@ -156,8 +181,33 @@ def summarize_telemetry(data, top: int) -> None:
         if st.get("rules"):
             line += f"   rules fired: {', '.join(st['rules'])}"
         print(line)
-    srv = data.get("serving")
-    if srv:
+
+    _block(data, "strategy_static", _st)
+
+    def _cal(cal):
+        # calibration digest (ISSUE 8): how straight the simulator's ruler
+        # is, which op bent it furthest, and whether the closed loop
+        # repaired it during this run
+        line = (f"calibration: {cal.get('profiled_keys', 0)} keys profiled"
+                f", aggregate sim-vs-measured "
+                f"{cal.get('aggregate_ratio', '?')}")
+        if cal.get("worst_key") is not None:
+            line += (f"   worst: {cal['worst_key']} "
+                     f"({cal.get('worst_ratio', '?')})")
+        line += (f"   out of band: {cal.get('out_of_band', 0)} "
+                 f"(tol {cal.get('tolerance', '?')})")
+        print(line)
+        if cal.get("recalibrations"):
+            after = cal.get("ratio_after")
+            print(f"  recalibrations applied: {cal['recalibrations']} "
+                  f"({cal.get('invalidated_entries', 0)} delta-cost "
+                  f"entries invalidated)"
+                  + (f"   aggregate ratio after repair: {after}"
+                     if after is not None else ""))
+
+    _block(data, "calibration", _cal)
+
+    def _srv(srv):
         # serving headline (ISSUE 6): request/token volume, queue pressure
         # and the per-token latency tail of the serve run
         line = (f"serving: {srv.get('requests_served', 0)} requests, "
@@ -169,12 +219,16 @@ def summarize_telemetry(data, top: int) -> None:
             line += (f"   p50/p99: {srv.get('p50_token_ms')}/"
                      f"{srv['p99_token_ms']} ms")
         print(line)
-    losses = data.get("loss_history", [])
-    if losses:
+
+    _block(data, "serving", _srv)
+
+    def _loss(losses):
         show = losses[:top]
         print(f"loss: first {len(show)} of {len(losses)}: "
               + ", ".join(f"{v:.4f}" for v in show)
               + (f" ... final {losses[-1]:.4f}" if len(losses) > top else ""))
+
+    _block(data, "loss_history", _loss)
 
 
 def summarize_jsonl(records, top: int) -> None:
@@ -229,6 +283,35 @@ def summarize_jsonl(records, top: int) -> None:
     print(f"{'event':32s} {'count':>8s}")
     for name, cnt in sorted(by_name.items(), key=lambda kv: -kv[1])[:top]:
         print(f"{name:32s} {cnt:8d}")
+    # calibration digest over an event sink (ISSUE 8): the drift sentinel's
+    # per-key alerts and any closed-loop repairs that ran
+    drifts = [r for r in records
+              if r.get("name") == "calibration_drift"
+              or r.get("event") == "calibration_drift"]
+    repairs = [r for r in records
+               if r.get("name") in ("calibration_repair",
+                                    "calibration_applied")
+               or r.get("event") in ("calibration_repair",
+                                     "calibration_applied")]
+    if drifts or repairs:
+        ops = {}
+        for r in drifts:
+            a = r.get("args", r)
+            if a.get("op") is not None:
+                ops[a["op"]] = a.get("ratio")
+        line = f"\ncalibration drift: {len(drifts)} alerts"
+        if ops:
+            worst = max(ops, key=lambda k: max(ops[k] or 1,
+                                               1 / (ops[k] or 1)))
+            line += (f" over {len(ops)} ops   worst: {worst} "
+                     f"(ratio {ops[worst]})")
+        print(line)
+        for r in repairs[-1:]:
+            a = r.get("args", r)
+            after = a.get("aggregate_ratio_after")
+            print(f"recalibration applied: {a.get('updated', '?')} keys"
+                  + (f"   aggregate ratio after repair: {after}"
+                     if after is not None else ""))
 
 
 def main(argv=None) -> int:
@@ -238,12 +321,19 @@ def main(argv=None) -> int:
                     help="rows to show (default 20)")
     args = ap.parse_args(argv)
     kind, payload = load(args.file)
-    if kind == "trace":
-        summarize_trace(payload, args.top)
-    elif kind == "telemetry":
-        summarize_telemetry(payload, args.top)
-    else:
-        summarize_jsonl(payload, args.top)
+    try:
+        if kind == "trace":
+            summarize_trace(payload, args.top)
+        elif kind == "telemetry":
+            summarize_telemetry(payload, args.top)
+        else:
+            summarize_jsonl(payload, args.top)
+    except Exception as e:  # noqa: BLE001 — a cross-PR artifact mismatch
+        # must degrade to a notice, never a traceback: telemetry formats
+        # and this summary evolve in different PRs (ISSUE 8 satellite)
+        print(f"note: {args.file} predates (or postdates) this summary's "
+              f"expectations ({type(e).__name__}: {e}); partial output "
+              "above")
     return 0
 
 

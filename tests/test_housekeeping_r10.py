@@ -1,0 +1,133 @@
+"""Round-10 housekeeping (ISSUE 8 satellites): the persistent calibration
+table's durability contract and the new flags' parse-time validation.
+
+* a table written by one Simulator reloads **bit-identically** on a fresh
+  one (sorted-key atomic JSON: a no-op load+save cycle must not move a
+  byte, so dedup tooling can diff tables textually);
+* unknown future fields — top-level AND per-entry — survive a
+  load+merge+save cycle untouched, so the schema can grow without
+  breaking old readers;
+* ``--drift-tolerance`` / ``--auto-recalibrate`` / ``--calibrate-from-trace``
+  fail fast at parse time (the PR 5 flag-check pattern), and the good
+  combinations parse.
+"""
+import json
+import os
+
+import pytest
+
+from flexflow_tpu import FFConfig
+from flexflow_tpu.search.calibration import (load_table, save_table,
+                                             store_persistent_calibration,
+                                             table_path)
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.simulator import Simulator
+
+
+def _sim(cal_dir):
+    # pinned generation/dtype: the table filename must not depend on what
+    # hardware the test host happens to expose
+    return Simulator(TPUMachineModel.from_generation("v5e", 1),
+                     calibration_dir=str(cal_dir), dtype_label="bf16")
+
+
+KEYS = [("Dense", ((16, 8),), (16, 16)), ("Softmax", ((16, 4),), (16, 4))]
+
+
+# ------------------------------------------------------------- round-trip
+def test_table_reloads_bit_identically(tmp_path):
+    """Fresh-instance reload then no-op re-store may not move a byte."""
+    cal_dir = tmp_path / "cal"
+    sim_a = _sim(cal_dir)
+    for i, k in enumerate(KEYS):
+        sim_a._key_calibration[k] = 1.5 + i
+    sim_a._key_bwd_ratio[KEYS[0]] = 2.25
+    path = store_persistent_calibration(sim_a)
+    assert path == table_path(str(cal_dir), "v5e", "bf16")
+    with open(path, "rb") as f:
+        written = f.read()
+
+    sim_b = _sim(cal_dir)  # loads at construction
+    assert set(sim_b._persisted_calibration) == {repr(k) for k in KEYS}
+    assert sim_b._persisted_calibration[repr(KEYS[0])]["calibration"] == 1.5
+    assert sim_b._persisted_calibration[repr(KEYS[0])]["bwd_ratio"] == 2.25
+    # b measured nothing: its store is a pure load+save cycle
+    assert not sim_b._key_calibration
+    store_persistent_calibration(sim_b)
+    with open(path, "rb") as f:
+        assert f.read() == written, "no-op store moved bytes"
+    # and the serializer itself is deterministic on a reloaded table
+    p2 = str(tmp_path / "copy.json")
+    save_table(p2, load_table(path))
+    with open(p2, "rb") as f:
+        assert f.read() == written
+
+
+def test_unknown_future_fields_survive_merge(tmp_path):
+    """A future writer's extra fields ride through load+merge+save, so the
+    schema can grow while old readers keep working."""
+    cal_dir = tmp_path / "cal"
+    path = table_path(str(cal_dir), "v5e", "bf16")
+    save_table(path, {
+        "format_version": 99, "future_top_level": {"a": [1, 2]},
+        "entries": {
+            repr(KEYS[0]): {"calibration": 3.0, "samples": 4,
+                            "future_per_entry": "keep-me"},
+            "('SomeOtherModelOp',)": {"calibration": 0.5, "samples": 1},
+        }})
+    sim = _sim(cal_dir)
+    # old reader adopts the known part of a future entry
+    assert sim._persisted_calibration[repr(KEYS[0])]["calibration"] == 3.0
+    sim._key_calibration[KEYS[0]] = 7.0  # new measurement for the same key
+    store_persistent_calibration(sim)
+    d = json.loads(open(path).read())
+    assert d["format_version"] == 99
+    assert d["future_top_level"] == {"a": [1, 2]}
+    ent = d["entries"][repr(KEYS[0])]
+    assert ent["calibration"] == 7.0  # newest measurement wins
+    assert ent["samples"] == 5  # accumulates
+    assert ent["future_per_entry"] == "keep-me"  # preserved verbatim
+    # entries for other keys (other models, other runs) are untouched
+    assert d["entries"]["('SomeOtherModelOp',)"]["calibration"] == 0.5
+
+
+def test_corrupt_table_never_breaks_construction(tmp_path):
+    cal_dir = tmp_path / "cal"
+    path = table_path(str(cal_dir), "v5e", "bf16")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("{not json")
+    sim = _sim(cal_dir)  # must not raise
+    assert sim._persisted_calibration == {}
+    with open(path, "w") as f:
+        f.write("[1, 2, 3]\n")  # valid JSON, wrong shape
+    assert load_table(path)["entries"] == {}
+
+
+# --------------------------------------------------- parse-time validation
+def test_calibration_flag_validation(tmp_path):
+    prof = tmp_path / "prof.jsonl"
+    prof.write_text("")
+    ok = FFConfig()
+    ok.parse_args(["--profile-ops", str(prof), "--drift-tolerance", "0.2",
+                   "--auto-recalibrate", "--calibration-dir",
+                   str(tmp_path)])
+    assert ok.profile_ops == str(prof) and ok.drift_tolerance == 0.2
+    assert ok.auto_recalibrate and ok.calibration_dir == str(tmp_path)
+    ok2 = FFConfig()
+    ok2.parse_args(["--calibrate-from-trace", str(prof)])
+    assert ok2.calibrate_from_trace == str(prof)
+
+    with pytest.raises(ValueError, match="must be > 0"):
+        FFConfig().parse_args(["--profile-ops", str(prof),
+                               "--drift-tolerance", "0"])
+    with pytest.raises(ValueError, match="must be > 0"):
+        FFConfig().parse_args(["--profile-ops", str(prof),
+                               "--drift-tolerance", "-0.5"])
+    with pytest.raises(ValueError, match="only meaningful with"):
+        FFConfig().parse_args(["--drift-tolerance", "0.2"])
+    with pytest.raises(ValueError, match="needs --profile-ops"):
+        FFConfig().parse_args(["--auto-recalibrate"])
+    with pytest.raises(ValueError, match="no such profile"):
+        FFConfig().parse_args(
+            ["--calibrate-from-trace", str(tmp_path / "missing.jsonl")])
