@@ -490,6 +490,42 @@ def serving_leg() -> dict:
             st.batch_occupancy(eng.n_slots), 3)
         out["serving_requests"] = st.requests_served
         out["serving_decode_compiles"] = eng.decode_compiles
+        # serving_degraded sub-leg (ISSUE 9, docs/serving.md "Serving
+        # under failure"): the same workload under a scripted ~20%
+        # decode-poison chaos mix plus a mid-run queue storm through the
+        # 'queue' shed policy — the tokens/s + p99 premium of surviving
+        # failure, next to the clean numbers above
+        try:
+            from flexflow_tpu.resilience import ChaosPlan
+
+            clean_tps = st.tokens_per_s()
+            poison = {s: (s // 5) % 8 for s in range(5, 61, 5)}
+            storm = {10: [rng.integers(0, cfg.vocab_size,
+                                       size=32).tolist()
+                          for _ in range(16)]}
+            config.shed_policy = "queue"
+            eng_d = ServingEngine(ff, n_slots=8, max_decode_len=256)
+            eng_d.generate(prompts, max_new_tokens=64,
+                           chaos=ChaosPlan(poison_decode_at=poison,
+                                           storm_queue=storm))
+            sd = eng_d.stats
+            out["serving_degraded_tokens_per_s"] = round(
+                sd.tokens_per_s(), 1)
+            p99d = sd.p99_token_ms()
+            if p99d is not None:
+                out["serving_degraded_p99_token_ms"] = round(p99d, 3)
+            out["serving_degraded_quarantines"] = sd.quarantines
+            out["serving_degraded_sheds"] = sd.sheds
+            out["serving_degraded_outcomes"] = dict(sd.outcomes)
+            if clean_tps > 0:
+                out["serving_degraded_vs_clean"] = round(
+                    sd.tokens_per_s() / clean_tps, 3)
+        except Exception as e:  # the chaos sub-leg must not sink the
+            # clean serving metrics above or the sim metrics below
+            out["serving_degraded_leg_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
+        finally:
+            config.shed_policy = "off"
         # simulated serving objective at 8 chips: the searched plan's
         # tokens/sec against naive dp replication (ranked always carries
         # the (8, 1) replicated point)

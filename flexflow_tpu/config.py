@@ -204,6 +204,24 @@ class FFConfig:
     # serving-objective SLO: simulated p99 per-token latency bound (ms) for
     # search_all(objective="serving"); 0 = throughput-only
     slo_p99_ms: float = 0.0
+    # serving resilience (flexflow_tpu/serving/resilience.py,
+    # docs/serving.md "Serving under failure"; ISSUE 9).
+    # Per-request completion deadline (ms from submission) defaulted onto
+    # every request without an explicit Request.deadline_ms; expired
+    # requests are evicted (outcome deadline_exceeded). 0 = no deadline.
+    request_timeout_ms: float = 0.0
+    # load shedding at admission: "off" (bounded queue only), "deadline"
+    # (shed when the EWMA completion estimate blows the request deadline),
+    # "queue" (shed at the max_queue//2 high-water mark). Shed requests get
+    # a typed OverloadError with a retry_after_ms hint.
+    shed_policy: str = "off"
+    # graceful SIGTERM drain: in-flight requests may finish for this many
+    # seconds before stragglers are evicted as preempted; queued requests
+    # are handed back for re-submission either way
+    drain_grace_s: float = 5.0
+    # decode-health sentinel: retries per request after a quarantined
+    # (non-finite) decode slot before the request aborts as decode_fault
+    decode_retry_budget: int = 1
 
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
@@ -376,6 +394,19 @@ class FFConfig:
                 self.max_inflight = int(_next())
             elif a == "--slo-p99-ms":
                 self.slo_p99_ms = float(_next())
+            elif a == "--request-timeout-ms":
+                self.request_timeout_ms = float(_next())
+            elif a == "--shed-policy":
+                v = _next()
+                if v not in ("off", "deadline", "queue"):
+                    raise ValueError(
+                        f"--shed-policy expects off|deadline|queue, got "
+                        f"{v!r}")
+                self.shed_policy = v
+            elif a == "--drain-grace-s":
+                self.drain_grace_s = float(_next())
+            elif a == "--decode-retry-budget":
+                self.decode_retry_budget = int(_next())
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -448,6 +479,21 @@ class FFConfig:
             raise ValueError(
                 f"--slo-p99-ms must be >= 0 (got {self.slo_p99_ms}); "
                 "0 disables the latency bound")
+        if "--request-timeout-ms" in seen and self.request_timeout_ms < 0:
+            raise ValueError(
+                f"--request-timeout-ms must be >= 0 (got "
+                f"{self.request_timeout_ms}); 0 disables per-request "
+                "deadlines")
+        if "--drain-grace-s" in seen and self.drain_grace_s < 0:
+            raise ValueError(
+                f"--drain-grace-s must be >= 0 (got {self.drain_grace_s}): "
+                "it bounds how long in-flight requests may finish after "
+                "SIGTERM (0 = evict immediately)")
+        if "--decode-retry-budget" in seen and self.decode_retry_budget < 0:
+            raise ValueError(
+                f"--decode-retry-budget must be >= 0 (got "
+                f"{self.decode_retry_budget}); 0 aborts a poisoned "
+                "request on its first quarantined decode")
         if "--drift-tolerance" in seen and self.drift_tolerance <= 0:
             raise ValueError(
                 f"--drift-tolerance must be > 0 (got "

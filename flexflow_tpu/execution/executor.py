@@ -774,7 +774,8 @@ class Executor:
         self._serving_jits[key] = fn
         return fn
 
-    def make_decode_step(self, max_decode_len: int, exact: bool = False):
+    def make_decode_step(self, max_decode_len: int, exact: bool = False,
+                         guard: bool = False):
         """Jitted ``(params, xs, state) -> (logits, new_state)``: ONE token
         per slot through the graph, consuming and extending the
         ``DecodeState`` ring buffers at each slot's ``lengths`` cursor.
@@ -784,10 +785,18 @@ class Executor:
         update in place on device. ``exact=True`` selects the
         bitwise-vs-full-forward attention numerics (ServingState.exact) at
         a max_len-x score-compute premium — the verification mode the
-        equivalence tests run."""
+        equivalence tests run. ``guard=True`` is the decode-health
+        sentinel (ISSUE 9, mirroring ``make_train_step(guard=True)``): the
+        step additionally returns ``ok`` — ``isfinite`` of each slot's
+        logits reduced to a (n_slots,) bool vector — fused into the same
+        program, so the only extra host traffic is that one bool vector
+        per step. The logits themselves are untouched: a poisoned slot's
+        quarantine decision is the HOST's (serving/resilience.py), and
+        every healthy slot's values stay bitwise-identical to the
+        unguarded step's."""
         import jax
 
-        key = ("decode", int(max_decode_len), bool(exact))
+        key = ("decode", int(max_decode_len), bool(exact), bool(guard))
         cached = self._serving_jits.get(key)
         if cached is not None:
             return cached
@@ -814,6 +823,9 @@ class Executor:
                 values[self.final_guid][self.final_out_idx])[:, 0]
             new_state = DecodeState(caches=sv.cache_out,
                                     lengths=state.lengths + 1)
+            if guard:
+                ok = jnp.all(jnp.isfinite(logits), axis=-1)
+                return logits, new_state, ok
             return logits, new_state
 
         fn = jax.jit(decode, donate_argnums=(2,))

@@ -130,6 +130,17 @@ class StepTelemetry:
         self.serving_p50_token_ms: Optional[float] = None
         self.serving_p99_token_ms: Optional[float] = None
         self.serving_tokens_per_s: Optional[float] = None
+        # serving-resilience counters (ISSUE 9): the outcome ledger of a
+        # serve() run (every request under exactly one of ok |
+        # deadline_exceeded | shed | decode_fault | preempted) plus the
+        # shed/deadline/quarantine/drain/replan event counts — filled by
+        # ServingEngine._merge_telemetry
+        self.serving_outcomes: Dict[str, int] = {}
+        self.serving_sheds: int = 0
+        self.serving_deadline_misses: int = 0
+        self.serving_quarantines: int = 0
+        self.serving_drains: int = 0
+        self.serving_replans: int = 0
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -265,6 +276,19 @@ class StepTelemetry:
             if self.serving_p99_token_ms is not None:
                 sv["p99_token_ms"] = round(self.serving_p99_token_ms, 3)
             out["serving"] = sv
+        if (self.serving_outcomes or self.serving_sheds
+                or self.serving_deadline_misses or self.serving_quarantines
+                or self.serving_drains or self.serving_replans):
+            total = max(sum(self.serving_outcomes.values()), 1)
+            out["serving_resilience"] = {
+                "outcomes": dict(self.serving_outcomes),
+                "shed_rate": round(self.serving_sheds / total, 4),
+                "deadline_miss_rate": round(
+                    self.serving_deadline_misses / total, 4),
+                "quarantines": self.serving_quarantines,
+                "drains": self.serving_drains,
+                "replans": self.serving_replans,
+            }
         return out
 
     def write(self, path: str) -> str:

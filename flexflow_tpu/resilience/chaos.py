@@ -73,7 +73,12 @@ class ChaosPlan:
                  fail_compiles: int = 0,
                  wrong_reshard: bool = False,
                  wrong_reshard_factor: float = 2.0,
-                 wrong_reshard_mode: str = "scale"):
+                 wrong_reshard_mode: str = "scale",
+                 poison_decode_at: Optional[dict] = None,
+                 storm_queue: Optional[dict] = None,
+                 storm_max_new_tokens: int = 4,
+                 preempt_serving_at: Optional[int] = None,
+                 drop_devices_at: Optional[dict] = None):
         self.nan_at_steps = {int(s) for s in nan_at_steps}
         self.preempt_at_step = (None if preempt_at_step is None
                                 else int(preempt_at_step))
@@ -82,6 +87,33 @@ class ChaosPlan:
         self.injected_nan_steps: List[int] = []
         self.preempted_at: Optional[int] = None
         self._nan_done: set = set()
+        # serving extensions (ISSUE 9, serving/resilience.py): step indices
+        # are DECODE-step counts of the serve loop (the serving analog of
+        # the training step index). poison_decode_at {step: slot} NaN's one
+        # slot's KV cache before that decode step dispatches (the guarded
+        # decode's isfinite verdict sees genuinely non-finite logits, as a
+        # flaky HBM bank would produce); storm_queue {step: [prompt, ...]}
+        # submits a scripted burst through the engine's admission control
+        # (driving shed-vs-accept deterministically); preempt_serving_at
+        # delivers a REAL SIGTERM before that decode step (graceful-drain
+        # path); drop_devices_at {step: surviving_n_dev} raises a scripted
+        # device loss (auto elastic_replan path).
+        self.poison_decode_at = {int(k): int(v) for k, v in
+                                 (poison_decode_at or {}).items()}
+        self.storm_queue = {int(k): list(v) for k, v in
+                            (storm_queue or {}).items()}
+        self.storm_max_new_tokens = int(storm_max_new_tokens)
+        self.preempt_serving_at = (None if preempt_serving_at is None
+                                   else int(preempt_serving_at))
+        self.drop_devices_at = {int(k): int(v) for k, v in
+                                (drop_devices_at or {}).items()}
+        self.poisoned_decode_steps: List[int] = []
+        self.storms_injected = 0
+        self.serving_preempted_at: Optional[int] = None
+        self.devices_dropped: List[int] = []
+        self._decode_poison_done: set = set()
+        self._storm_done: set = set()
+        self._drop_done: set = set()
         # strategy-safety injections (resilience/fallback.py, audit.py)
         self.fail_compiles = int(fail_compiles)
         self.compile_failures_injected = 0
@@ -192,6 +224,69 @@ class ChaosPlan:
             return
         self.preempted_at = step
         os.kill(os.getpid(), self.preempt_signal)
+
+    # -- hooks called by the serving engine (ISSUE 9) -----------------------
+    def maybe_poison_decode(self, step: int, state):
+        """NaN one slot's KV-cache rows before decode step ``step``
+        dispatches; returns ``(state, slot-or-None)``. Poisoning the cache
+        (not the logits post-hoc) means the guarded decode step's fused
+        isfinite check judges genuinely non-finite arithmetic — the same
+        contract as ``poison_batch`` for the training sentinel. Floating
+        leaves only (length cursors stay intact); batch-row independence
+        of the decode ops keeps every other slot bitwise-untouched."""
+        slot = self.poison_decode_at.get(step)
+        if slot is None or (self.once and step in self._decode_poison_done):
+            return state, None
+        import jax
+        import jax.numpy as jnp
+
+        def nanify(leaf):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf
+            return leaf.at[slot].set(jnp.asarray(float("nan"), leaf.dtype))
+
+        from ..serving.kvcache import DecodeState
+
+        caches = {name: jax.tree.map(nanify, entry)
+                  for name, entry in state.caches.items()}
+        self._decode_poison_done.add(step)
+        self.poisoned_decode_steps.append(step)
+        return DecodeState(caches=caches, lengths=state.lengths), slot
+
+    def maybe_storm(self, step: int) -> List:
+        """Scripted queue storm: the prompt burst to submit through the
+        engine's admission control before decode step ``step`` (empty list
+        when nothing is scheduled). Determinism: same script + same
+        engine state -> same shed/accept pattern."""
+        if step not in self.storm_queue or \
+                (self.once and step in self._storm_done):
+            return []
+        self._storm_done.add(step)
+        self.storms_injected += 1
+        return list(self.storm_queue[step])
+
+    def maybe_preempt_serving(self, step: int) -> None:
+        """Deliver the scripted preemption signal before decode step
+        ``step`` — through ``os.kill`` so the REAL flag-only handler
+        (resilience/session.py) runs; the serve loop then drains
+        gracefully exactly as a TPU-pool SIGTERM would make it."""
+        if self.preempt_serving_at is None \
+                or self.serving_preempted_at is not None \
+                or step != self.preempt_serving_at:
+            return
+        self.serving_preempted_at = step
+        os.kill(os.getpid(), self.preempt_signal)
+
+    def maybe_drop_devices(self, step: int) -> Optional[int]:
+        """Scripted device loss before decode step ``step``: returns the
+        surviving device count (the engine raises ``DeviceLossError`` and
+        auto-replans onto it) or None."""
+        n = self.drop_devices_at.get(step)
+        if n is None or (self.once and step in self._drop_done):
+            return None
+        self._drop_done.add(step)
+        self.devices_dropped.append(step)
+        return n
 
 
 class _InjectedReductionOp:

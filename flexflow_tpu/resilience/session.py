@@ -34,7 +34,11 @@ from .sentinel import GuardedTrainStep
 
 
 class ResilienceSession:
-    def __init__(self, ffmodel, chaos=None):
+    def __init__(self, ffmodel, chaos=None, signals_only: bool = False):
+        # signals_only (ISSUE 9): the serving engine reuses ONLY the
+        # flag-only preemption handlers for its graceful drain — no
+        # checkpoint writer thread, no train-step guard, even when the
+        # model's config has training-side resilience armed
         cfg = ffmodel.config
         self.model = ffmodel
         self.chaos = chaos
@@ -42,12 +46,13 @@ class ResilienceSession:
         self.checkpoint_every = max(int(
             getattr(cfg, "checkpoint_every", 0) or 0), 0)
         self.manager: Optional[CheckpointManager] = None
-        if getattr(cfg, "checkpoint_dir", ""):
+        if getattr(cfg, "checkpoint_dir", "") and not signals_only:
             self.manager = CheckpointManager(
                 ffmodel, cfg.checkpoint_dir,
                 keep=getattr(cfg, "keep_checkpoints", 3))
         self.guard: Optional[GuardedTrainStep] = None
-        if int(getattr(cfg, "max_bad_steps", 0) or 0) > 0:
+        if int(getattr(cfg, "max_bad_steps", 0) or 0) > 0 \
+                and not signals_only:
             self.guard = GuardedTrainStep(ffmodel.executor,
                                           cfg.max_bad_steps)
         self.rollback_lr_factor = float(

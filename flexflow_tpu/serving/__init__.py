@@ -8,7 +8,11 @@ prototype; this subsystem is that story finished in JAX.
 """
 from .kvcache import DecodeState, ServingState  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
-                        Request, bucket_for, default_buckets)
+                        Request, ServingRejection, bucket_for,
+                        default_buckets)
 from .engine import ServingEngine, ServingStats  # noqa: F401
+from .resilience import (AdmissionController,  # noqa: F401
+                         DecodeStateLostError, DeviceLossError,
+                         OUTCOMES, OverloadError, ServingResilience)
 from .search import (ServingCandidate, ServingPlan,  # noqa: F401
                      ServingSearchError, serving_search)

@@ -23,13 +23,22 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..ffconst import OperatorType
 from .kvcache import DecodeState, update_slot_entry
-from .scheduler import ContinuousBatchScheduler, Request, default_buckets
+from .scheduler import (ContinuousBatchScheduler, Request, ServingRejection,
+                        bucket_for, default_buckets)
+
+# per-token latency reservoir bound (ISSUE 9 satellite): the old unbounded
+# list grew one float per token for the life of the serve loop — a
+# traffic-serving process leaks. p50/p99 are computed over a sliding
+# window of the most recent TOKEN_WALL_WINDOW walls instead (plenty for a
+# stable tail estimate; the summary fields are unchanged).
+TOKEN_WALL_WINDOW = 8192
 
 
 @dataclasses.dataclass
@@ -44,8 +53,28 @@ class ServingStats:
     queue_depth_hwm: int = 0
     wall_s: float = 0.0
     # per-token latency distribution: decode tokens carry their step wall,
-    # first tokens their prefill wall
-    token_walls_s: List[float] = dataclasses.field(default_factory=list)
+    # first tokens their prefill wall. Bounded ring (TOKEN_WALL_WINDOW):
+    # percentiles describe the trailing window, not the whole run
+    token_walls_s: Deque[float] = dataclasses.field(
+        default_factory=lambda: deque(maxlen=TOKEN_WALL_WINDOW))
+    # resilience ledger (ISSUE 9): every request leaves the system under
+    # exactly one outcome (ok | deadline_exceeded | shed | decode_fault |
+    # preempted); the counters mirror serving/resilience.py's events
+    outcomes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    sheds: int = 0
+    deadline_misses: int = 0
+    quarantines: int = 0
+    decode_retries: int = 0
+    drains: int = 0
+    replans: int = 0
+    drained_returned: int = 0
+
+    def record_token(self, wall_s: float) -> None:
+        self.token_walls_s.append(wall_s)
+
+    def count_outcome(self, outcome: str, n: int = 1) -> None:
+        if n:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + int(n)
 
     def tokens_per_s(self) -> float:
         return self.tokens_generated / self.wall_s if self.wall_s > 0 else 0.0
@@ -62,12 +91,12 @@ class ServingStats:
     def p50_token_ms(self) -> Optional[float]:
         if not self.token_walls_s:
             return None
-        return float(np.percentile(self.token_walls_s, 50) * 1e3)
+        return float(np.percentile(list(self.token_walls_s), 50) * 1e3)
 
     def p99_token_ms(self) -> Optional[float]:
         if not self.token_walls_s:
             return None
-        return float(np.percentile(self.token_walls_s, 99) * 1e3)
+        return float(np.percentile(list(self.token_walls_s), 99) * 1e3)
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -83,6 +112,14 @@ class ServingStats:
         if p50 is not None:
             out["p50_token_ms"] = round(p50, 3)
             out["p99_token_ms"] = round(p99, 3)
+        if self.outcomes:
+            out["outcomes"] = dict(self.outcomes)
+        for k in ("sheds", "deadline_misses", "quarantines",
+                  "decode_retries", "drains", "replans",
+                  "drained_returned"):
+            v = getattr(self, k)
+            if v:
+                out[k] = v
         return out
 
 
@@ -129,6 +166,22 @@ class ServingEngine:
         self.stats = ServingStats()
         self.plan = None  # ServingPlan from the last (re)search, if any
         self._search_sim = None  # warm Simulator for elastic re-search
+        # resilience (ISSUE 9, serving/resilience.py): the admission
+        # controller's EWMA cost model lives on the ENGINE so it warms
+        # across serve() runs; resilience_clock (ms) overrides the time
+        # base of every deadline/drain decision (deterministic tests);
+        # drained_requests holds the queued requests a graceful SIGTERM
+        # drain handed back for re-submission
+        from .resilience import AdmissionController
+
+        self.admission = AdmissionController()
+        self.resilience_clock = None
+        self.drained_requests: List[Request] = []
+        self._last_guard = False
+        # resilience state accumulated by pre-serve admit() calls (shed
+        # counts, deadline arming) — consumed by the next serve() so the
+        # ledger never loses events to a throwaway policy object
+        self._pending_resilience = None
 
     # ------------------------------------------------------------ validation
     def _validate_graph(self) -> None:
@@ -217,9 +270,13 @@ class ServingEngine:
     @property
     def decode_compiles(self) -> Optional[int]:
         """Entries in the decode step's jit cache — the recompile-free
-        contract is exactly ``== 1`` after warmup (asserted in tier-1)."""
+        contract is exactly ``== 1`` after warmup (asserted in tier-1).
+        The key includes the guard mode of the last serve (guarded and
+        unguarded decode are distinct programs, each with its own
+        one-entry contract)."""
         fn = self.executor._serving_jits.get(
-            ("decode", self.max_decode_len, self.exact_decode))
+            ("decode", self.max_decode_len, self.exact_decode,
+             self._last_guard))
         if fn is None:
             return None
         try:
@@ -228,9 +285,10 @@ class ServingEngine:
             return None
 
     # ------------------------------------------------------------ device fns
-    def _decode_fn(self):
+    def _decode_fn(self, guard: bool = False):
         return self.executor.make_decode_step(self.max_decode_len,
-                                              exact=self.exact_decode)
+                                              exact=self.exact_decode,
+                                              guard=guard)
 
     def _prefill_fn(self, bucket: int):
         return self.executor.make_prefill_step(bucket, self.max_decode_len)
@@ -328,117 +386,435 @@ class ServingEngine:
         return fn
 
     # ------------------------------------------------------------- main loop
+    def _make_resilience(self, chaos):
+        from .resilience import ServingResilience
+
+        return ServingResilience(self.model.config, chaos=chaos,
+                                 controller=self.admission,
+                                 clock=self.resilience_clock)
+
+    def admit(self, sched: ContinuousBatchScheduler, req: Request,
+              resilience=None) -> None:
+        """Resilient admission (ISSUE 9): deadline stamp + shed-policy
+        gate + scheduler submit. Raises ``OverloadError`` (shed) or
+        ``QueueFullError`` (hard queue wall) — both ``ServingRejection``,
+        so callers write one except clause. Without an explicit
+        ``resilience``, events accumulate on a pending policy object the
+        next ``serve()`` consumes — a pre-serve shed or deadline stamp is
+        never lost to a throwaway."""
+        res = resilience
+        if res is None:
+            if self._pending_resilience is None:
+                self._pending_resilience = self._make_resilience(None)
+            res = self._pending_resilience
+        res.admit(sched, req)
+
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, eos_id: Optional[int] = None,
-                 seed: int = 0) -> List[List[int]]:
+                 seed: int = 0, chaos=None,
+                 deadline_ms: Optional[float] = None) -> List[List[int]]:
         """Generate continuations for ``prompts`` (token-id sequences)
         through the continuous-batching loop; returns the generated token
         lists in submission order. Deterministic for a given (prompts,
-        sampling params, seed) regardless of slot timing."""
+        sampling params, seed) regardless of slot timing. ``deadline_ms``
+        stamps each request with a relative completion budget (defaulted
+        from ``--request-timeout-ms``); a request shed at admission or
+        evicted/drained mid-serve returns its partial (possibly empty)
+        continuation, with ``Request.outcome`` recording why — read
+        ``self.stats.outcomes`` / ``self.drained_requests`` for the
+        ledger."""
         self._token_input_check()
+        res = self._make_resilience(chaos)
         sched = ContinuousBatchScheduler(
             n_slots=self.n_slots, max_queue=max(len(prompts),
                                                 self.max_queue),
-            buckets=self.buckets, max_len=self.max_decode_len)
+            buckets=self.buckets, max_len=self.max_decode_len,
+            clock=res.clock)
+        sched.shed_policy = res.shed_policy
         reqs = []
         for i, p in enumerate(prompts):
             r = Request(prompt=np.asarray(p, dtype=np.int32),
                         max_new_tokens=max_new_tokens,
                         eos_id=self.eos_id if eos_id is None else eos_id,
-                        rng_tag=i)
-            sched.submit(r)
+                        rng_tag=i, deadline_ms=deadline_ms)
+            try:
+                res.admit(sched, r)
+            except ServingRejection:
+                pass  # r.outcome == "shed"; ledger picks it up in serve()
             reqs.append(r)
-        self.serve(sched, temperature=temperature, top_k=top_k, seed=seed)
+        self.serve(sched, temperature=temperature, top_k=top_k, seed=seed,
+                   chaos=chaos, resilience=res)
         return [list(r.generated) for r in reqs]
 
     def serve(self, sched: ContinuousBatchScheduler,
               temperature: float = 0.0, top_k: int = 0,
-              seed: int = 0) -> ServingStats:
+              seed: int = 0, chaos=None, resilience=None) -> ServingStats:
         """Drive the scheduler until queue and slots drain. One decode
         step advances EVERY live slot one token (iteration-level
-        batching); prefills are interleaved the moment a slot frees."""
+        batching); prefills are interleaved the moment a slot frees.
+
+        Resilience (ISSUE 9, serving/resilience.py): the loop installs
+        the flag-only SIGTERM/SIGINT handler from ``resilience/session.py``
+        — a preemption signal turns into a graceful drain (admission
+        stops, in-flight requests finish within ``--drain-grace-s``,
+        queued ones are handed back via ``self.drained_requests``). When
+        any resilience feature is armed (deadlines, a shed policy, or a
+        ``ChaosPlan``) every decode iteration additionally sweeps expired
+        deadlines and runs the guarded decode step, whose per-slot
+        isfinite verdict quarantines only a poisoned slot (retry on a
+        fresh slot per ``--decode-retry-budget``) while co-batched
+        streams continue bit-identically. A device-loss error triggers
+        the existing ``elastic_replan`` automatically with bounded
+        backoff. A plain serve (nothing armed) pays none of the
+        per-iteration costs."""
         import jax
         import jax.numpy as jnp
+
+        from ..resilience.session import ResilienceSession
+        from .resilience import DecodeStateLostError, ServingResilience
 
         tracer = self._tracer()
         params = self.model.params
         sampler = self._sampler(temperature, top_k)
         stats = self.stats = ServingStats()
+        pending = self._pending_resilience
+        res = resilience or pending or self._make_resilience(chaos)
+        self._pending_resilience = None  # consumed
+        if pending is not None and res is not pending:
+            # pre-serve admit() calls ledgered their sheds (and deadline
+            # arming) on the pending object; carry them into the object
+            # this serve reports from so no rejection goes uncounted
+            res.sheds += pending.sheds
+            res._saw_deadline = res._saw_deadline or pending._saw_deadline
+        if chaos is not None:
+            res.chaos = chaos
+        chaos = res.chaos
+        sched.shed_policy = res.shed_policy
+        # ONE time base: submit stamps were taken with the scheduler's
+        # clock, so every sweep/drain decision reads the same clock — a
+        # mismatched engine.resilience_clock on a caller-built scheduler
+        # would otherwise make expired() compare across time bases
+        res.clock = sched.clock
+        # requests submitted straight to the scheduler (sched.submit, the
+        # PR 6 pattern) never passed res.admit: stamp config-default
+        # deadlines and arm the sweeps for any caller-set deadline_ms so
+        # the documented enforcement does not depend on the entry point
+        for r in list(sched.queue) + [s for s in sched.slots
+                                      if s is not None]:
+            res.stamp_deadline(r)
+        res_active = res.armed
+        guard = bool(res_active)
+        self._last_guard = guard
+        self.drained_requests = []
+        session = ResilienceSession(self.model, signals_only=True)
+        session.install_signal_handlers()
         base_rng = jax.random.PRNGKey(seed)
         step_no = 0
+        storm_seq = 0
+        draining = False
+        drain_deadline_ms = None
         t0 = time.perf_counter()
-        while True:
-            action = sched.next_action()
-            if action is None:
-                break
-            if action[0] == "prefill":
-                _, req, slot, bucket = action
-                t_p = time.perf_counter()
-                ids = np.zeros((1, bucket), np.int32)
-                ids[0, :req.prompt_len] = req.prompt
-                _logits, last, cache = self._prefill_fn(bucket)(
-                    params, [jnp.asarray(ids)],
-                    jnp.asarray([req.prompt_len], jnp.int32))
-                self._ensure_state(cache)
-                # per-request rng: deterministic under co-scheduling — the
-                # stream depends on the request's submission tag, not slot
-                # timing (folded in-jit from (tag, 0))
-                tag = req.rng_tag if req.rng_tag is not None else req.rid
-                tok = int(jax.device_get(
-                    sampler(last, base_rng,
-                            np.asarray([[tag, 0]], np.int32))[0]))
-                wall = time.perf_counter() - t_p
-                stats.prefills += 1
-                stats.token_walls_s.append(wall)
-                stats.tokens_generated += 1
-                req.first_token_step = step_no
+        try:
+            while True:
+                if not draining and session.preempted:
+                    # flag-only handler fired: graceful drain — stop
+                    # admitting, let in-flight requests finish inside the
+                    # grace window, hand the queue back
+                    draining = True
+                    sched.draining = True
+                    res.drains += 1
+                    session.note_preemption(stats.decode_steps)
+                    drain_deadline_ms = res.clock() + \
+                        res.drain_grace_s * 1e3
+                    if tracer.enabled:
+                        tracer.event("serving_drain",
+                                     step=stats.decode_steps,
+                                     queued=sched.queued,
+                                     active=sched.active,
+                                     grace_s=res.drain_grace_s)
+                if draining and sched.active and \
+                        res.clock() > drain_deadline_ms:
+                    # grace exhausted: stragglers are evicted (outcome
+                    # preempted), never silently dropped
+                    for slot, r in enumerate(list(sched.slots)):
+                        if r is not None:
+                            sched.evict(slot, "preempted")
+                    break
+                if res_active and res.deadlines_armed:
+                    self._sweep_deadlines(sched, res, tracer)
+                action = sched.next_action()
+                if action is None:
+                    break
+                if action[0] == "prefill":
+                    _, req, slot, bucket = action
+                    if res_active and req.expired(res.clock()):
+                        # expired while queued but swept into a slot in
+                        # the same iteration: evict before paying prefill
+                        res.deadline_misses += 1
+                        sched.evict(slot, "deadline_exceeded")
+                        continue
+                    t_p = time.perf_counter()
+                    # effective prompt = prompt + committed tokens: empty
+                    # suffix for a fresh request, the full committed
+                    # stream for a decode-fault retry re-prefill
+                    eff = req.effective_len
+                    cur = req.current_prompt()
+                    ids = np.zeros((1, bucket), np.int32)
+                    ids[0, :eff] = cur
+                    _logits, last, cache = self._prefill_fn(bucket)(
+                        params, [jnp.asarray(ids)],
+                        jnp.asarray([eff], jnp.int32))
+                    self._ensure_state(cache)
+                    # per-request rng: deterministic under co-scheduling —
+                    # the stream depends on (submission tag, tokens
+                    # emitted), not slot timing; a retry resumes its
+                    # stream exactly where the quarantine cut it
+                    tag = req.rng_tag if req.rng_tag is not None \
+                        else req.rid
+                    tok = int(jax.device_get(
+                        sampler(last, base_rng,
+                                np.asarray([[tag, len(req.generated)]],
+                                           np.int32))[0]))
+                    wall = time.perf_counter() - t_p
+                    stats.prefills += 1
+                    stats.record_token(wall)
+                    stats.tokens_generated += 1
+                    if req.first_token_step is None:
+                        req.first_token_step = step_no
+                    if tracer.enabled:
+                        tracer.complete("prefill", wall, rid=req.rid,
+                                        bucket=bucket, slot=slot,
+                                        prompt_len=eff)
+                    if not sched.commit_token(slot, tok):
+                        self._write_slot(cache, slot, eff, tok)
+                    continue
+                # decode: one token for every live slot. Sampling covers
+                # ALL slots (free ones with a dummy rng, their draws
+                # discarded) so the sampler's shapes are as static as the
+                # decode step's — the whole loop compiles a bounded,
+                # occupancy-independent set of programs.
+                _, live = action
+                k = stats.decode_steps  # the chaos-script step index
+                if chaos is not None:
+                    chaos.maybe_preempt_serving(k)
+                    for p in chaos.maybe_storm(k):
+                        r = Request(prompt=np.asarray(p, np.int32),
+                                    max_new_tokens=(
+                                        chaos.storm_max_new_tokens),
+                                    eos_id=self.eos_id,
+                                    rng_tag=1_000_000 + storm_seq)
+                        storm_seq += 1
+                        try:
+                            res.admit(sched, r)
+                        except ServingRejection:
+                            pass  # counted by the controller; outcome shed
+                    if self.state is not None:
+                        self.state, poisoned = chaos.maybe_poison_decode(
+                            k, self.state)
+                        if poisoned is not None and tracer.enabled:
+                            tracer.event("decode_poison", step=k,
+                                         slot=poisoned)
+                t_d = time.perf_counter()
+                try:
+                    logits, ok_vec = self._dispatch_decode(
+                        params, res, chaos, k, guard, tracer)
+                except DecodeStateLostError:
+                    # the slot pool died with the device. Committed
+                    # tokens are host-side on each Request, so recovery
+                    # is the quarantine-retry path applied to EVERY live
+                    # stream: back to the queue front, re-prefilled onto
+                    # the rebuilt pool (rng streams key on (tag,
+                    # tokens_emitted) — continuations are unchanged). A
+                    # stream whose committed length outgrew the prefill
+                    # buckets cannot re-enter and is evicted (preempted).
+                    for slot, req in live:
+                        try:
+                            bucket_for(req.effective_len, sched.buckets)
+                        except ValueError:
+                            sched.evict(slot, "preempted")
+                            continue
+                        sched.quarantine(slot)
+                    self.state = None
+                    self._last_tokens = None
+                    if tracer.enabled:
+                        tracer.event("serving_state_rebuild", step=k,
+                                     requeued=len(live))
+                    continue
+                live_map = dict(live)
+                # per-slot rng streams depend on (submission tag, tokens
+                # emitted), never on slot index or batch composition —
+                # built as ONE host numpy array, folded in-jit
+                tag_counts = np.zeros((self.n_slots, 2), np.int32)
+                for s, r in live_map.items():
+                    tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
+                        else r.rid
+                    tag_counts[s, 1] = len(r.generated)
+                toks = sampler(logits, base_rng, tag_counts)
+                self._last_tokens = toks[:, None]
+                if ok_vec is not None:
+                    # the ONE extra transfer of the guarded step: the
+                    # per-slot finite verdict rides the same device_get
+                    toks_host, ok_host = jax.device_get((toks, ok_vec))
+                    toks_host = np.asarray(toks_host)
+                    ok_host = np.asarray(ok_host)
+                else:
+                    toks_host = np.asarray(jax.device_get(toks))
+                    ok_host = None
+                wall = time.perf_counter() - t_d
+                stats.decode_steps += 1
+                step_no += 1
+                if res_active:
+                    res.controller.observe_step(wall, len(live))
+                for slot, req in live:
+                    if ok_host is not None and not bool(ok_host[slot]):
+                        # poisoned slot: quarantine it alone — the token
+                        # is NOT committed, neighbors proceed untouched
+                        self._quarantine(sched, res, slot, req, tracer)
+                        continue
+                    stats.tokens_generated += 1
+                    stats.record_token(wall)
+                    sched.commit_token(slot, int(toks_host[slot]))
                 if tracer.enabled:
-                    tracer.complete("prefill", wall, rid=req.rid,
-                                    bucket=bucket, slot=slot,
-                                    prompt_len=req.prompt_len)
-                if not sched.commit_token(slot, tok):
-                    self._write_slot(cache, slot, req.prompt_len, tok)
-                continue
-            # decode: one token for every live slot. Sampling covers ALL
-            # slots (free ones with a dummy rng, their draws discarded) so
-            # the sampler's shapes are as static as the decode step's —
-            # the whole loop compiles a bounded, occupancy-independent set
-            # of programs.
-            _, live = action
-            t_d = time.perf_counter()
-            decode = self._decode_fn()
-            logits, self.state = decode(params, [self._last_tokens],
-                                        self.state)
-            live_map = dict(live)
-            # per-slot rng streams depend on (submission tag, tokens
-            # emitted), never on slot index or batch composition — built
-            # as ONE host numpy array, folded in-jit by the sampler
-            tag_counts = np.zeros((self.n_slots, 2), np.int32)
-            for s, r in live_map.items():
-                tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
-                    else r.rid
-                tag_counts[s, 1] = len(r.generated)
-            toks = sampler(logits, base_rng, tag_counts)
-            self._last_tokens = toks[:, None]
-            toks_host = np.asarray(jax.device_get(toks))
-            wall = time.perf_counter() - t_d
-            stats.decode_steps += 1
-            step_no += 1
-            for slot, req in live:
-                stats.tokens_generated += 1
-                stats.token_walls_s.append(wall)
-                sched.commit_token(slot, int(toks_host[slot]))
-            if tracer.enabled:
-                tracer.complete("decode_step", wall, step=step_no,
-                                live_slots=len(live))
+                    tracer.complete("decode_step", wall, step=step_no,
+                                    live_slots=len(live))
+            if draining:
+                self.drained_requests = sched.pop_queued()
+                if tracer.enabled:
+                    tracer.event("serving_drain_done",
+                                 returned=len(self.drained_requests),
+                                 finished=len(sched.finished))
+        finally:
+            session.close()
         stats.wall_s = time.perf_counter() - t0
-        stats.requests_served = len(sched.finished)
+        # clean (outcome ok) completions only — evicted/failed requests
+        # are accounted in the outcome ledger below, not as "served"
+        stats.requests_served = sum(
+            1 for r in sched.finished if (r.outcome or "ok") == "ok")
         stats.queue_depth_hwm = sched.queue_depth_hwm
+        # outcome ledger: every request that entered the system leaves
+        # under exactly one outcome
+        for r in sched.finished:
+            stats.count_outcome(r.outcome or "ok")
+        stats.count_outcome("shed", res.sheds)
+        stats.count_outcome("preempted", len(self.drained_requests))
+        stats.sheds = res.sheds
+        stats.deadline_misses = res.deadline_misses
+        stats.quarantines = res.quarantines
+        stats.decode_retries = res.decode_retries
+        stats.drains = res.drains
+        stats.replans = res.replans
+        stats.drained_returned = len(self.drained_requests)
         self._merge_telemetry(sched, stats)
         if tracer.enabled and self.model.config.trace_file:
             tracer.write(self.model.config.trace_file)
         return stats
+
+    # ------------------------------------------------------ resilience hooks
+    def _sweep_deadlines(self, sched, res, tracer) -> None:
+        """Deadline enforcement at the iteration boundary: expired queued
+        requests are dropped before they cost a prefill; expired in-flight
+        requests are evicted and their slot recycled (outcome
+        ``deadline_exceeded`` either way)."""
+        now = res.clock()
+        for req in [r for r in sched.queue if r.expired(now)]:
+            res.deadline_misses += 1
+            sched.drop_queued(req, "deadline_exceeded")
+            if tracer.enabled:
+                tracer.event("deadline_exceeded", rid=req.rid, queued=True)
+        for slot, req in enumerate(list(sched.slots)):
+            if req is not None and req.expired(now):
+                res.deadline_misses += 1
+                sched.evict(slot, "deadline_exceeded")
+                if tracer.enabled:
+                    tracer.event("deadline_exceeded", rid=req.rid,
+                                 slot=slot,
+                                 tokens=len(req.generated))
+
+    def _quarantine(self, sched, res, slot: int, req, tracer) -> None:
+        """Decode-health verdict said this slot's logits are non-finite:
+        quarantine the slot, retry the request on a fresh slot while its
+        retry budget lasts (re-prefilling prompt + committed tokens so the
+        stream continues exactly where it stopped), abort it with outcome
+        ``decode_fault`` once the budget is spent."""
+        res.quarantines += 1
+        retryable = req.retries_used < res.decode_retry_budget
+        if retryable:
+            try:
+                bucket_for(req.effective_len, sched.buckets)
+            except ValueError:
+                retryable = False  # committed stream outgrew the buckets
+        if retryable:
+            req.retries_used += 1
+            res.decode_retries += 1
+            sched.quarantine(slot)
+            if tracer.enabled:
+                tracer.event("decode_quarantine", rid=req.rid, slot=slot,
+                             retry=req.retries_used,
+                             tokens=len(req.generated))
+        else:
+            res.decode_faults += 1
+            sched.evict(slot, "decode_fault")
+            if tracer.enabled:
+                tracer.event("decode_fault", rid=req.rid, slot=slot,
+                             retries_used=req.retries_used)
+
+    def _dispatch_decode(self, params, res, chaos, k: int, guard: bool,
+                         tracer):
+        """One decode dispatch with device-loss failover: a scripted
+        (``ChaosPlan.drop_devices_at``) or real device-loss error triggers
+        ``elastic_replan`` onto the survivors with bounded linear backoff.
+        When the DecodeState survives the hop (chaos injection, or an
+        error raised before the donated buffers were consumed) generation
+        resumes from it bit-identically; when it did NOT (a real loss
+        mid-execution — the buffers were donated to the failed dispatch
+        or lived on the lost chips) ``DecodeStateLostError`` tells the
+        serve loop to rebuild the pool and re-prefill every live stream
+        from its host-side committed tokens instead of retrying into an
+        'Array has been deleted'. Returns ``(logits, ok_vec-or-None)``."""
+        import jax
+
+        from .resilience import (DecodeStateLostError, DeviceLossError,
+                                 looks_like_device_loss,
+                                 state_buffers_lost)
+
+        attempt = 0
+        while True:
+            try:
+                if chaos is not None:
+                    n = chaos.maybe_drop_devices(k)
+                    if n is not None:
+                        raise DeviceLossError(n)
+                decode = self._decode_fn(guard=guard)
+                if guard:
+                    logits, self.state, ok = decode(
+                        params, [self._last_tokens], self.state)
+                    return logits, ok
+                logits, self.state = decode(params, [self._last_tokens],
+                                            self.state)
+                return logits, None
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                if not looks_like_device_loss(e):
+                    raise
+                surviving = e.n_dev if isinstance(e, DeviceLossError) \
+                    else len(jax.devices())
+                attempt += 1
+                if attempt > res.max_replan_attempts:
+                    raise
+                if tracer.enabled:
+                    tracer.event("serving_device_loss", step=k,
+                                 surviving=surviving, attempt=attempt)
+                # first retry is immediate; repeats back off linearly
+                if attempt > 1 and res.replan_backoff_s > 0:
+                    time.sleep(res.replan_backoff_s * (attempt - 1))
+                self.elastic_replan(surviving)
+                res.replans += 1
+                if state_buffers_lost(self.state, self._last_tokens):
+                    raise DecodeStateLostError(
+                        f"DecodeState lost with the device at step {k} "
+                        "(buffers donated to the failed dispatch or "
+                        "resident on the lost chips); re-prefilling live "
+                        "streams from committed tokens") from e
 
     def _merge_telemetry(self, sched, stats: ServingStats) -> None:
         """Publish the run into a StepTelemetry ``serving`` block (mirrors
@@ -458,6 +834,14 @@ class ServingEngine:
         tel.serving_p50_token_ms = stats.p50_token_ms()
         tel.serving_p99_token_ms = stats.p99_token_ms()
         tel.serving_tokens_per_s = round(stats.tokens_per_s(), 2)
+        # serving_resilience block (ISSUE 9): the outcome ledger + event
+        # counters, mirroring the resilience/strategy_safety blocks
+        tel.serving_outcomes = dict(stats.outcomes)
+        tel.serving_sheds = stats.sheds
+        tel.serving_deadline_misses = stats.deadline_misses
+        tel.serving_quarantines = stats.quarantines
+        tel.serving_drains = stats.drains
+        tel.serving_replans = stats.replans
         tel.finalize()
         if self.model.config.telemetry_file:
             tel.write(self.model.config.telemetry_file)

@@ -1,0 +1,303 @@
+"""Serving under fire: deadlines, load shedding, decode-health quarantine,
+graceful drain and device-loss failover for the serving engine (ISSUE 9).
+
+The PR 6 engine was happy-path only: its one overload behavior was the
+bounded-queue ``QueueFullError``, a non-finite logit poisoned every
+co-batched stream, and SIGTERM mid-serve dropped all in-flight requests —
+while the *training* loop already had atomic checkpoints, divergence
+sentinels and chaos coverage (PRs 4–5). This module is the serving-side
+counterpart, reusing that machinery at the Orca-style iteration-level
+scheduler's natural enforcement point (every admission and every decode
+iteration is a decision):
+
+* **deadlines** — ``Request.deadline_ms`` (default from
+  ``--request-timeout-ms``), enforced at admission and at every decode
+  iteration; expired requests are evicted with outcome
+  ``deadline_exceeded`` and their slot recycled.
+* **admission control / load shedding** — :class:`AdmissionController`
+  keeps an EWMA of per-token decode cost; queue depth times that cost
+  yields an estimated completion time, and :meth:`ServingResilience.admit`
+  sheds (typed :class:`OverloadError` with a ``retry_after_ms`` hint) per
+  ``--shed-policy``:
+
+  - ``off``      — never shed (the bounded queue remains the only wall);
+  - ``deadline`` — shed when the completion estimate blows the request's
+    deadline (a request that cannot meet its SLO wastes capacity better
+    spent on ones that can);
+  - ``queue``    — shed once queue depth reaches the high-water mark
+    ``max_queue // 2`` (early backpressure before the hard
+    ``QueueFullError`` wall), regardless of deadlines.
+
+* **decode-health quarantine** — the guarded decode step
+  (``Executor.make_decode_step(guard=True)``, mirroring PR 4's guarded
+  train step) returns a per-slot ``isfinite`` verdict on the decode
+  logits for ONE extra bool-vector transfer; a poisoned slot is
+  quarantined alone (co-batched streams continue bit-identically), its
+  request retried once per ``--decode-retry-budget`` on a fresh slot by
+  re-prefilling prompt + committed tokens, and repeated poisoning aborts
+  the request with outcome ``decode_fault``.
+* **graceful drain** — ``ServingEngine.serve`` installs the flag-only
+  SIGTERM/SIGINT handler from ``resilience/session.py``; on preemption
+  admission stops, in-flight requests finish within ``--drain-grace-s``
+  (stragglers are evicted as ``preempted``), and still-queued requests
+  are handed back for re-submission to another replica.
+* **device-loss failover** — a decode dispatch that dies with a
+  device-loss-shaped error (or a scripted ``ChaosPlan.drop_devices_at``)
+  triggers the existing ``elastic_replan`` automatically, with bounded
+  backoff, and the in-flight ``DecodeState`` survives the hop.
+
+Every path is exercised deterministically in tier-1 via the ``ChaosPlan``
+serving extensions (``poison_decode_at`` / ``storm_queue`` /
+``preempt_serving_at`` / ``drop_devices_at``) —
+tests/test_serving_resilience.py.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .scheduler import (ContinuousBatchScheduler, QueueFullError, Request,
+                        ServingRejection, now_ms)
+
+#: terminal request dispositions — every request that enters the system
+#: leaves it under exactly one of these (asserted end-to-end in tier-1)
+OUTCOMES = ("ok", "deadline_exceeded", "shed", "decode_fault", "preempted")
+
+SHED_POLICIES = ("off", "deadline", "queue")
+
+
+class OverloadError(ServingRejection):
+    """Admission shed by the load controller (``--shed-policy``): the
+    estimated completion time blows the request's deadline, or the queue
+    crossed its high-water mark. Carries the same ``queued``/``active``/
+    ``retry_after_ms`` fields as ``QueueFullError`` via the shared
+    ``ServingRejection`` base — one except clause handles both."""
+
+
+class DeviceLossError(RuntimeError):
+    """A decode dispatch lost (some of) its devices. Raised by the chaos
+    hook (``ChaosPlan.drop_devices_at``) and synthesized from real
+    device-loss-shaped runtime errors; the engine answers with an
+    automatic ``elastic_replan`` onto the survivors."""
+
+    def __init__(self, n_dev: int, message: str = ""):
+        super().__init__(message or f"device loss: {n_dev} device(s) "
+                         "surviving")
+        self.n_dev = int(n_dev)
+
+
+# substrings (lowercased) that mark a runtime error as device loss rather
+# than a program bug — the conservative detector behind the auto-replan
+_DEVICE_LOSS_MARKERS = ("device_unavailable", "device unavailable",
+                       "failed_precondition: device",
+                       "tpu is unhealthy", "device is lost",
+                       "chip unreachable", "slice has been terminated")
+
+
+def looks_like_device_loss(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return isinstance(exc, DeviceLossError) or \
+        any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+class DecodeStateLostError(RuntimeError):
+    """The in-flight DecodeState did not survive a device-loss error —
+    its buffers were donated to the failed dispatch or resident on the
+    lost chips. The serve loop answers by rebuilding the slot pool and
+    re-prefilling every live stream from its host-side committed tokens
+    (``Request.current_prompt``), so generation still resumes exactly
+    where it stopped."""
+
+
+def state_buffers_lost(*trees) -> bool:
+    """True when any jax array leaf in ``trees`` has been invalidated
+    (deleted by donation to a dispatch that failed, or lost with its
+    device) — retrying a decode with such a leaf raises an opaque
+    'Array has been deleted' instead of resuming."""
+    import jax
+
+    for tree in trees:
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            deleted = getattr(leaf, "is_deleted", None)
+            if callable(deleted) and deleted():
+                return True
+    return False
+
+
+class AdmissionController:
+    """EWMA cost model behind load shedding.
+
+    ``observe_step`` feeds each decode iteration's wall time and the
+    number of live slots it advanced; the controller keeps an
+    exponentially-weighted moving average of the per-token decode cost
+    (ms). The completion estimate for a new request is then
+
+        est_ms = token_cost_ms * (backlog_tokens / n_slots
+                                  + max_new_tokens)
+
+    where ``backlog_tokens`` counts the remaining tokens of every
+    IN-FLIGHT slot as well as every queued request — a saturated slot
+    pool delays a new request's first token exactly like a deep queue
+    does. The backlog drains at ``n_slots`` tokens per step while the
+    request itself needs ``max_new_tokens`` more steps once admitted
+    (iteration-level batching: a step costs one token-time regardless of
+    occupancy).
+    ``retry_after_ms`` is the backlog-drain half of that estimate, the
+    hint a shed caller should wait before resubmitting.
+
+    The controller lives on the ENGINE (not per serve() run) so the cost
+    model warms across runs; ``force_token_cost_ms`` pins the cost for
+    deterministic tests and scripted capacity planning.
+    """
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self._ewma_token_ms: Optional[float] = None
+        self.observed_steps = 0
+        self.force_token_cost_ms: Optional[float] = None
+
+    @property
+    def token_cost_ms(self) -> float:
+        if self.force_token_cost_ms is not None:
+            return float(self.force_token_cost_ms)
+        return self._ewma_token_ms or 0.0
+
+    def observe_step(self, wall_s: float, tokens: int) -> None:
+        cost = wall_s * 1e3 / max(int(tokens), 1)
+        if self._ewma_token_ms is None:
+            self._ewma_token_ms = cost
+        else:
+            self._ewma_token_ms += self.alpha * (cost - self._ewma_token_ms)
+        self.observed_steps += 1
+
+    # ------------------------------------------------------------ estimates
+    @staticmethod
+    def _backlog_tokens(sched: ContinuousBatchScheduler) -> int:
+        """Remaining tokens ahead of a NEW request: queued requests plus
+        the in-flight slots' unfinished work — omitting the latter would
+        under-shed exactly when the slot pool is saturated."""
+        queued = sum(r.max_new_tokens - len(r.generated)
+                     for r in sched.queue)
+        inflight = sum(r.max_new_tokens - len(r.generated)
+                       for r in sched.slots if r is not None)
+        return queued + inflight
+
+    def estimate_completion_ms(self, req: Request,
+                               sched: ContinuousBatchScheduler) -> float:
+        backlog = self._backlog_tokens(sched)
+        return self.token_cost_ms * (backlog / max(sched.n_slots, 1)
+                                     + req.max_new_tokens)
+
+    def retry_after_ms(self, sched: ContinuousBatchScheduler) -> float:
+        return self.token_cost_ms * (self._backlog_tokens(sched)
+                                     / max(sched.n_slots, 1))
+
+
+class ServingResilience:
+    """Per-serve()-run resilience policy + counters.
+
+    Owns the knobs (``--request-timeout-ms`` / ``--shed-policy`` /
+    ``--drain-grace-s`` / ``--decode-retry-budget``), the shared
+    :class:`AdmissionController`, the clock every deadline decision reads
+    (injectable for deterministic tests — one time base for submit stamps,
+    sweeps and drain grace), and the event counters the engine merges into
+    ``ServingStats`` / the ``StepTelemetry`` ``serving_resilience`` block.
+    """
+
+    def __init__(self, config, chaos=None,
+                 controller: Optional[AdmissionController] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.chaos = chaos
+        self.request_timeout_ms = float(
+            getattr(config, "request_timeout_ms", 0.0) or 0.0)
+        self.shed_policy = (getattr(config, "shed_policy", "off")
+                            or "off")
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy must be one of {SHED_POLICIES}, got "
+                f"{self.shed_policy!r}")
+        self.drain_grace_s = float(
+            getattr(config, "drain_grace_s", 5.0))
+        self.decode_retry_budget = int(
+            getattr(config, "decode_retry_budget", 1))
+        self.controller = controller or AdmissionController()
+        self.clock = clock if clock is not None else now_ms
+        # counters (merged into ServingStats / telemetry by the engine)
+        self.sheds = 0
+        self.deadline_misses = 0
+        self.quarantines = 0
+        self.decode_retries = 0
+        self.decode_faults = 0
+        self.drains = 0
+        self.replans = 0
+        # failover bounds: a replan that keeps failing must not loop
+        # forever — bounded linear backoff, then the error propagates
+        self.max_replan_attempts = 3
+        self.replan_backoff_s = 0.5
+        self._saw_deadline = False
+
+    @property
+    def armed(self) -> bool:
+        """Any serving-resilience feature active? The plain serve loop
+        pays zero extra cost (no guarded decode, no per-iteration sweeps)
+        when this is False — mirroring ``ResilienceSession.wanted``. A
+        caller-set ``Request.deadline_ms`` arms it even with every config
+        knob at its default (``deadlines_armed`` tracks the stamps)."""
+        return bool(self.chaos is not None or self.shed_policy != "off"
+                    or self.deadlines_armed)
+
+    # -------------------------------------------------------------- deadline
+    @property
+    def deadlines_armed(self) -> bool:
+        return self.request_timeout_ms > 0 or self._saw_deadline
+
+    def stamp_deadline(self, req: Request) -> None:
+        """Default a request's deadline from --request-timeout-ms; a
+        caller-set ``deadline_ms`` wins."""
+        if req.deadline_ms is None and self.request_timeout_ms > 0:
+            req.deadline_ms = self.request_timeout_ms
+        if req.deadline_ms is not None:
+            self._saw_deadline = True
+
+    # ------------------------------------------------------------- admission
+    def admit(self, sched: ContinuousBatchScheduler, req: Request) -> None:
+        """Deadline stamp + shed-policy gate + scheduler submit. Raises
+        :class:`OverloadError` (shed) or ``QueueFullError`` (hard wall);
+        both are ``ServingRejection`` and both are counted here as
+        outcome ``shed`` — a rejected request never enters the queue but
+        still leaves the system under exactly one outcome."""
+        self.stamp_deadline(req)
+        policy = self.shed_policy
+        if policy == "queue":
+            highwater = max(sched.max_queue // 2, 1)
+            if sched.queued >= highwater:
+                self.sheds += 1
+                req.outcome = "shed"
+                raise OverloadError(
+                    f"request {req.rid} shed (policy 'queue'): queue depth "
+                    f"{sched.queued} >= high-water {highwater} "
+                    f"(max_queue {sched.max_queue})",
+                    queued=sched.queued, active=sched.active,
+                    retry_after_ms=self.controller.retry_after_ms(sched))
+        elif policy == "deadline" and req.deadline_ms is not None \
+                and req.deadline_ms > 0:
+            est = self.controller.estimate_completion_ms(req, sched)
+            if est > req.deadline_ms:
+                self.sheds += 1
+                req.outcome = "shed"
+                raise OverloadError(
+                    f"request {req.rid} shed (policy 'deadline'): "
+                    f"estimated completion {est:.1f} ms exceeds deadline "
+                    f"{req.deadline_ms:.1f} ms",
+                    queued=sched.queued, active=sched.active,
+                    retry_after_ms=self.controller.retry_after_ms(sched))
+        try:
+            sched.submit(req)
+        except QueueFullError:
+            # the hard wall sheds too (policy 'off' has no earlier gate):
+            # the rejection still lands in the ledger under exactly one
+            # outcome instead of vanishing from the accounting
+            self.sheds += 1
+            req.outcome = "shed"
+            raise

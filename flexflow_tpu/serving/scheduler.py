@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Deque, List, Optional, Sequence, Tuple
 
@@ -26,7 +27,37 @@ import numpy as np
 _req_counter = itertools.count(1)
 
 
-class QueueFullError(RuntimeError):
+def now_ms() -> float:
+    """Default monotonic time base (ms) for deadline/drain decisions —
+    ONE definition shared by the scheduler and the resilience policy so
+    the two clocks cannot drift apart in units."""
+    return time.monotonic() * 1e3
+
+
+class ServingRejection(RuntimeError):
+    """Common base of every admission refusal (ISSUE 9): the bounded-queue
+    ``QueueFullError`` and the load shedder's ``OverloadError``
+    (serving/resilience.py) both carry the same retry context, so a caller
+    writes ONE except clause:
+
+        try:
+            engine.admit(sched, req)
+        except ServingRejection as e:
+            backoff(e.retry_after_ms); resubmit later
+
+    ``queued``/``active`` snapshot the scheduler at refusal time;
+    ``retry_after_ms`` is the admission controller's drain-time hint (0.0
+    when no cost estimate exists yet)."""
+
+    def __init__(self, message: str, queued: int = 0, active: int = 0,
+                 retry_after_ms: float = 0.0):
+        super().__init__(message)
+        self.queued = int(queued)
+        self.active = int(active)
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class QueueFullError(ServingRejection):
     """Admission refused: the bounded submit queue is at capacity
     (``max_queue``). Callers should retry later or shed load — this is the
     backpressure signal, not an internal failure."""
@@ -51,10 +82,42 @@ class Request:
     # (submission order) rather than the process-global ``rid`` counter, so
     # the same (prompts, seed) reproduces the same draws run after run
     rng_tag: Optional[int] = None
+    # resilience (ISSUE 9, docs/serving.md "Serving under failure"):
+    # deadline_ms is the relative completion budget from submission (None =
+    # no deadline; the engine defaults it from --request-timeout-ms);
+    # submit_ms is stamped by the scheduler's clock at submit; outcome is
+    # the terminal disposition, exactly one of
+    # ok | deadline_exceeded | shed | decode_fault | preempted;
+    # retries_used counts decode-fault re-prefills against the
+    # --decode-retry-budget
+    deadline_ms: Optional[float] = None
+    submit_ms: float = 0.0
+    outcome: Optional[str] = None
+    retries_used: int = 0
 
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    @property
+    def effective_len(self) -> int:
+        """Prompt length the NEXT prefill of this request needs: the
+        original prompt plus everything already generated — a decode-fault
+        retry re-prefills the full committed stream onto a fresh slot so
+        generation continues exactly where the quarantine cut it."""
+        return self.prompt_len + len(self.generated)
+
+    def current_prompt(self) -> np.ndarray:
+        """Token ids the next prefill feeds: ``prompt`` for a fresh
+        request, ``prompt + generated`` for a quarantine retry."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def expired(self, now_ms: float) -> bool:
+        return (self.deadline_ms is not None and self.deadline_ms > 0
+                and now_ms - self.submit_ms > self.deadline_ms)
 
 
 def default_buckets(max_prompt_len: int, min_bucket: int = 16
@@ -101,7 +164,7 @@ class ContinuousBatchScheduler:
 
     def __init__(self, n_slots: int, max_queue: int = 64,
                  buckets: Optional[Sequence[int]] = None,
-                 max_len: int = 128):
+                 max_len: int = 128, clock=None):
         assert n_slots >= 1, "need at least one decode slot"
         self.n_slots = n_slots
         self.max_queue = max_queue
@@ -116,6 +179,17 @@ class ContinuousBatchScheduler:
         self.queue_depth_hwm = 0
         self.admitted = 0
         self.recycled = 0
+        # resilience (ISSUE 9): submit stamps each request with this clock
+        # (ms) so deadline math shares one time base with the engine's
+        # sweeps; injectable for deterministic tests. The shed policy in
+        # effect is recorded here so the backpressure refusal can NAME it;
+        # draining=True stops admission (next_action only decodes) during a
+        # graceful SIGTERM drain.
+        self.clock = clock if clock is not None else now_ms
+        self.shed_policy = "off"
+        self.draining = False
+        self.quarantined = 0
+        self.evicted = 0
 
     # ------------------------------------------------------------ admission
     @property
@@ -130,16 +204,22 @@ class ContinuousBatchScheduler:
         """FIFO admission with bounded-queue backpressure."""
         if len(self.queue) >= self.max_queue:
             raise QueueFullError(
-                f"serving queue full ({self.max_queue} waiting); "
-                "retry later or raise --max-inflight/max_queue")
+                f"serving queue full ({self.max_queue} waiting, shed "
+                f"policy '{self.shed_policy}'); retry later or raise "
+                "--max-inflight/max_queue",
+                queued=len(self.queue), active=self.active)
         if req.prompt_len + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"request {req.rid}: prompt {req.prompt_len} + "
                 f"max_new_tokens {req.max_new_tokens} exceeds the decode "
                 f"ring capacity {self.max_len} (--max-decode-len)")
         # fail HERE, not after next_action() already claimed a slot: a
-        # prompt no bucket covers must never corrupt the slot pool
-        bucket_for(req.prompt_len, self.buckets)
+        # prompt no bucket covers must never corrupt the slot pool.
+        # effective_len (prompt + committed tokens) is what the prefill
+        # actually feeds — a drained quarantine-retry resubmitted to a
+        # narrower scheduler must be refused at submit too
+        bucket_for(req.effective_len, self.buckets)
+        req.submit_ms = float(self.clock())
         self.queue.append(req)
         self.queue_depth_hwm = max(self.queue_depth_hwm, len(self.queue))
 
@@ -148,14 +228,17 @@ class ContinuousBatchScheduler:
         """("prefill", request, slot, bucket_len) when a request can be
         admitted into a free slot — prefill takes priority so freed
         capacity never idles while work queues; else ("decode",
-        [(slot, request), ...]) over the in-flight slots; else None."""
-        if self.queue and self._free:
+        [(slot, request), ...]) over the in-flight slots; else None.
+        While ``draining`` (graceful SIGTERM shutdown) admission stops:
+        only decode actions are produced, so in-flight requests finish and
+        the queue is left intact for the engine to hand back."""
+        if self.queue and self._free and not self.draining:
             req = self.queue.popleft()
             slot = self._free.popleft()
             self.slots[slot] = req
             self.admitted += 1
             return ("prefill", req, slot,
-                    bucket_for(req.prompt_len, self.buckets))
+                    bucket_for(req.effective_len, self.buckets))
         live = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if live:
             return ("decode", live)
@@ -174,12 +257,72 @@ class ContinuousBatchScheduler:
             return self._finish(slot, "length")
         return False
 
-    def _finish(self, slot: int, reason: str) -> bool:
+    def _finish(self, slot: int, reason: str,
+                outcome: str = "ok") -> bool:
         req = self.slots[slot]
         req.done = True
         req.finish_reason = reason
+        req.outcome = outcome
         self.finished.append(req)
         self.slots[slot] = None
         self._free.append(slot)
         self.recycled += 1
         return True
+
+    # ---------------------------------------------------------- resilience
+    # ISSUE 9: the engine's deadline sweeps, decode-health quarantine and
+    # graceful drain manipulate the slot pool through these — slot-state
+    # invariants (one request per slot, freed slots fully re-prefilled
+    # before any read) stay enforced in ONE place.
+    def evict(self, slot: int, outcome: str) -> Request:
+        """Terminate the request in ``slot`` with a failure ``outcome``
+        (deadline_exceeded | decode_fault | preempted) and recycle the
+        slot. The evicted request is finished — it lands in ``finished``
+        with ``outcome`` set, never silently dropped."""
+        req = self.slots[slot]
+        assert req is not None, f"evict of empty slot {slot}"
+        self.evicted += 1
+        self._finish(slot, outcome, outcome=outcome)
+        return req
+
+    def drop_queued(self, req: Request, outcome: str) -> None:
+        """Remove a still-queued request (it never held a slot) with a
+        terminal ``outcome`` — the admission-time half of deadline
+        enforcement."""
+        # Identity-based removal: Request is a dataclass holding ndarrays,
+        # so ``list.remove`` (== comparison) is ambiguous.
+        for i, q in enumerate(self.queue):
+            if q is req:
+                del self.queue[i]
+                break
+        else:
+            raise ValueError(f"request rid={req.rid} is not queued")
+        req.done = True
+        req.finish_reason = outcome
+        req.outcome = outcome
+        self.finished.append(req)
+
+    def quarantine(self, slot: int) -> Request:
+        """Pull a decode-poisoned request out of ``slot`` for a retry on a
+        fresh slot: the slot returns to the BACK of the free pool (so the
+        retry prefers a different slot when one is available — its rows
+        are fully overwritten by the next prefill either way) and the
+        request re-enters the queue at the FRONT, keeping its committed
+        tokens (``current_prompt`` re-prefills prompt + generated)."""
+        req = self.slots[slot]
+        assert req is not None, f"quarantine of empty slot {slot}"
+        self.slots[slot] = None
+        self._free.append(slot)
+        self.quarantined += 1
+        self.queue.appendleft(req)
+        return req
+
+    def pop_queued(self) -> List[Request]:
+        """Drain handoff: hand back every still-queued request (outcome
+        ``preempted``) for re-submission to another replica — they never
+        started, so their state is clean."""
+        out = list(self.queue)
+        self.queue.clear()
+        for r in out:
+            r.outcome = "preempted"
+        return out

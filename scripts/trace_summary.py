@@ -222,6 +222,27 @@ def summarize_telemetry(data, top: int) -> None:
 
     _block(data, "serving", _srv)
 
+    def _srvres(sr):
+        # serving-under-failure headline (ISSUE 9): the outcome ledger of
+        # the serve run — every request under exactly one outcome — and
+        # how hard the resilience layer had to work
+        oc = sr.get("outcomes", {})
+        parts = [f"{k}={oc[k]}" for k in
+                 ("ok", "deadline_exceeded", "shed", "decode_fault",
+                  "preempted") if oc.get(k)]
+        line = "serving resilience: " + (" ".join(parts) or "no outcomes")
+        if sr.get("shed_rate"):
+            line += f"   shed rate {sr['shed_rate']}"
+        if sr.get("deadline_miss_rate"):
+            line += f"   deadline misses {sr['deadline_miss_rate']}"
+        print(line)
+        if sr.get("quarantines") or sr.get("drains") or sr.get("replans"):
+            print(f"  quarantines: {sr.get('quarantines', 0)}   "
+                  f"drains: {sr.get('drains', 0)}   "
+                  f"replans: {sr.get('replans', 0)}")
+
+    _block(data, "serving_resilience", _srvres)
+
     def _loss(losses):
         show = losses[:top]
         print(f"loss: first {len(show)} of {len(losses)}: "
