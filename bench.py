@@ -214,6 +214,16 @@ def main():
     # tracked BENCH metric (CPU-sim tier included so every round records it)
     with tracer.span("calibration_leg"):
         result.update(calibration_leg(ff, xd))
+    # both tiers (ISSUE 10): schedule-priced pipeline identity + the
+    # collective-overlap wall ratio — measured on TPU, simulated-fallback
+    # (clearly labeled) on CPU so every round records the trajectory
+    with tracer.span("pipeline_schedules_leg"):
+        result.update(pipeline_schedules_leg(on_tpu))
+    with tracer.span("collective_overlap_leg"):
+        result.update(collective_overlap_leg(on_tpu, cfg))
+    if not on_tpu:
+        with tracer.span("mfu_bf16opt_sim_leg"):
+            result.update(mfu_bf16opt_sim_leg())
     if on_tpu:
         legs = [("cost_model_checks",
                  lambda: cost_model_checks(ff, config, dt,
@@ -834,6 +844,199 @@ def memsearch_remat_leg(cfg, headline_result) -> dict:
                     da / dx, 3)
     except Exception as e:
         out["memsearch_remat_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def pipeline_schedules_leg(on_tpu) -> dict:
+    """The searched_pipeline identity leg (ISSUE 10; VERDICT flags it as
+    never run on-chip): price the BERT-Large 8-dev pipeline candidate
+    [4, 2, 8] per SCHEDULE (gpipe / 1f1b / interleaved-v2) with the
+    task-graph engine, and on TPU run the real PipelineTrainer per
+    schedule, comparing the measured step wall to the simulator's
+    prediction (searched_pipeline_identity_<sched> = sim / measured).
+    On CPU the leg emits the simulated numbers with
+    ``searched_pipeline_simulated: true`` so every round records the
+    schedule trajectory even when the chips are away."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.unity import simulate_pipeline
+
+    out = {}
+    try:
+        # batch 16: the [4,2,8] grid needs microbatches of 2 rows so each
+        # splits over dp=2 (batch 8 would give mb=1 — the trainer refuses)
+        if on_tpu:
+            cfg = BertConfig(batch_size=16, seq_len=512, hidden=1024,
+                             num_heads=16, num_layers=24,
+                             intermediate=4096)
+            machine = TPUMachineModel.detect(8)
+        else:
+            cfg = BertConfig.tiny(batch_size=16)
+            machine = TPUMachineModel.from_generation("v5e", 8)
+        config = FFConfig()
+        config.batch_size = cfg.batch_size
+        ff = FFModel(config)
+        build_bert(ff, cfg)
+        pcg = ff.create_pcg()
+        sim = Simulator(machine)
+        sim.activation_el = 2  # bf16 activations, the validated model
+        pp, pdp, n_micro = 4, 2, 8
+        sims = {}
+        for sched, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 2)):
+            t, mem = simulate_pipeline(sim, pcg, pp, pdp, n_micro,
+                                       remat="full", schedule=sched, v=v)
+            sims[sched] = t
+            out[f"pipeline_sim_ms_{sched}"] = round(t * 1e3, 3)
+            out[f"pipeline_sim_mem_mib_{sched}"] = round(mem / 2 ** 20, 1)
+        # bubble margins vs the gpipe baseline (>= 1 means the schedule
+        # shaves the bubble; 1f1b's margin is ~1 — same bubble fraction,
+        # its win is the in-flight memory — interleaved's is the real one)
+        for sched in ("1f1b", "interleaved"):
+            out[f"pipeline_bubble_margin_{sched}"] = round(
+                sims["gpipe"] / sims[sched], 4)
+        if not on_tpu or len(jax.devices()) < pp * pdp:
+            out["searched_pipeline_simulated"] = True
+            return out
+        # measured identity: the REAL trainer per schedule on the chips
+        from flexflow_tpu import LossType, SGDOptimizer
+        from flexflow_tpu.parallel.pipeline import PipelineTrainer
+
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
+                       ).astype(np.float32)
+        y = rng.integers(0, cfg.num_classes,
+                         size=(cfg.batch_size,)).astype(np.int32)
+        for sched, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 2)):
+            config2 = FFConfig()
+            config2.batch_size = cfg.batch_size
+            ff2 = FFModel(config2)
+            build_bert(ff2, cfg)
+            tr = PipelineTrainer(
+                ff2, pp=pp, dp=pdp, n_micro=n_micro,
+                optimizer=SGDOptimizer(None, lr=1e-3),
+                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                schedule=sched, virtual_stages=v)
+            tr.train_step(x, y, rng_seed=0)  # compile + settle
+            t0 = time.perf_counter()
+            iters = 8
+            for i in range(iters):
+                tr.train_step(x, y, rng_seed=1 + i)
+            dt = (time.perf_counter() - t0) / iters
+            out[f"searched_pipeline_step_ms_{sched}"] = round(dt * 1e3, 2)
+            out[f"searched_pipeline_identity_{sched}"] = round(
+                sims[sched] / dt, 3)
+    except Exception as e:
+        out["pipeline_schedules_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def collective_overlap_leg(on_tpu, cfg) -> dict:
+    """--collective-overlap on/off step wall on the headline model (ISSUE
+    10 acceptance: the overlap path must be no worse than synchronous).
+    The on/off numerics are bitwise-identical (tier-1 asserts it); this
+    leg records what the scheduling freedom buys:
+    collective_overlap_step_ratio = t_on / t_off (<= ~1.0 is the win).
+    Runs on BOTH tiers — the CPU number is a smoke ratio (one host
+    'device' has nothing to overlap), the TPU number is the real one."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, DataType, FFConfig, FFModel, \
+        LossType
+    from flexflow_tpu.models.bert import build_bert
+
+    out = {}
+    try:
+        walls = {}
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(cfg.batch_size, cfg.seq_len, cfg.hidden)
+                       ).astype(np.float32)
+        y = rng.integers(0, cfg.num_classes,
+                         size=(cfg.batch_size, 1)).astype(np.int32)
+        for mode in ("off", "on"):
+            config = FFConfig()
+            config.batch_size = cfg.batch_size
+            if on_tpu:
+                config.compute_dtype = DataType.DT_BFLOAT16
+            config.collective_overlap = mode
+            ff = FFModel(config)
+            build_bert(ff, cfg)
+            ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+                       loss_type=LossType.
+                       LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+            xd = [jax.device_put(x, ff.executor.batch_sharding(3))]
+            yd = jax.device_put(y, ff.executor.batch_sharding(2))
+            if on_tpu:
+                walls[mode] = _time_step(ff, xd, yd, warmup=2)
+            else:  # CPU smoke: one short window
+                import jax.random as jrandom
+
+                step = ff.executor.make_train_step()
+                params, opt_state = ff.params, ff.opt_state
+                params, opt_state, loss, _ = step(
+                    params, opt_state, xd, yd, jrandom.PRNGKey(0))
+                _ = float(loss)
+                t0 = time.perf_counter()
+                for i in range(3):
+                    params, opt_state, loss, _ = step(
+                        params, opt_state, xd, yd, jrandom.PRNGKey(1 + i))
+                _ = float(loss)
+                walls[mode] = (time.perf_counter() - t0) / 3
+            out[f"step_ms_overlap_{mode}"] = round(walls[mode] * 1e3, 2)
+        out["collective_overlap_step_ratio"] = round(
+            walls["on"] / walls["off"], 4)
+    except Exception as e:
+        out["collective_overlap_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def mfu_bf16opt_sim_leg() -> dict:
+    """CPU simulated fallback for the measured mfu_bf16opt leg (ISSUE 10;
+    VERDICT flags the measured leg as never run on-chip): price the
+    BERT-Large single-chip step with the analytic simulator at bf16
+    activations, with the optimizer's HBM stream shrunk to bf16 moments
+    (~16 of the f32 recipe's ~28 bytes/param — the same arithmetic the
+    AdamOptimizer moment_dtype knob buys), and report the roofline MFU
+    estimate as mfu_bf16opt_sim. The measured leg still runs (and
+    overrides the story) whenever the chips are reachable."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.bert import (BertConfig,
+                                          bert_train_flops_per_step,
+                                          build_bert)
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.simulator import OpSharding, Simulator
+    from flexflow_tpu.search.unity import simulate_best
+
+    out = {}
+    try:
+        cfg = BertConfig(batch_size=8, seq_len=512, hidden=1024,
+                         num_heads=16, num_layers=24, intermediate=4096)
+        config = FFConfig()
+        config.batch_size = cfg.batch_size
+        ff = FFModel(config)
+        build_bert(ff, cfg)
+        pcg = ff.create_pcg()
+        sim = Simulator(TPUMachineModel.from_generation("v5e", 1))
+        sim.activation_el = 2
+        sim.update_bytes_factor = sim.update_bytes_factor * 16.0 / 28.0
+        dp1 = {n.guid: OpSharding(dp=1) for n in pcg.compute_nodes()}
+        sim_t = simulate_best(sim, pcg, dp1, {})
+        fl = bert_train_flops_per_step(cfg)
+        # roofline against the SIMULATED chip's peak (v5e), not the CPU
+        # tier's placeholder — the simulated MFU must be comparable to the
+        # measured mfu_bf16opt series
+        from flexflow_tpu.obs.telemetry import PEAK_FLOPS
+
+        out["mfu_bf16opt_sim"] = round(fl / sim_t / PEAK_FLOPS["v5e"], 4)
+        out["step_ms_bf16opt_sim"] = round(sim_t * 1e3, 2)
+    except Exception as e:
+        out["mfu_bf16opt_sim_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

@@ -179,16 +179,27 @@ def check_rng_streams(pcg) -> List[Diagnostic]:
 
 # ------------------------------------------------------------------- FF004
 def check_remat(pcg, level: str, segment_size: int = 8,
-                segments: Optional[Sequence[Sequence[int]]] = None
-                ) -> List[Diagnostic]:
+                segments: Optional[Sequence[Sequence[int]]] = None,
+                kind: str = "remat") -> List[Diagnostic]:
     """FF004: the remat segmentation must partition the compute nodes
     (every node checkpointed exactly once) and respect the topological
     order — an edge flowing backwards across a cut means a block would
     consume a boundary value produced by a LATER block, which the
     checkpointed forward cannot thread (a stateful CacheOp edge cut this
-    way is the pre-PR 6 decode-state bug class)."""
-    if not level or level == "none":
+    way is the pre-PR 6 decode-state bug class).
+
+    ``kind="stage"`` judges a PIPELINE stage-chunk segmentation by the
+    same two laws (partition + topological cuts) with stage-cut wording.
+    Note the laws are about CUT ORDER in the graph, not device placement:
+    the interleaved schedule's round-robin chunk->device assignment
+    (chunk c on device c % pp, pp*v chunks) is a legal segmentation — a
+    validator that conflated chunk index with device rank would
+    misdiagnose every interleaved plan as a backwards stage cut
+    (ISSUE 10; tests/test_pipeline_schedules.py pins this)."""
+    if kind == "remat" and (not level or level == "none"):
         return []
+    what_seg = "remat" if kind == "remat" else "stage-chunk"
+    block = "remat block" if kind == "remat" else "stage chunk"
     if segments is None:
         from ..execution.remat import remat_segments
 
@@ -209,7 +220,7 @@ def check_remat(pcg, level: str, segment_size: int = 8,
         names = [pcg.nodes[g].name for g in guids if g in pcg.nodes]
         out.append(Diagnostic(
             rule_id="FF004", node=names[0] if names else "",
-            message=(f"remat segmentation {what} compute node(s) "
+            message=(f"{what_seg} segmentation {what} compute node(s) "
                      f"{names}: the blocks do not partition the graph, so "
                      "the checkpointed forward and the simulator's memory "
                      "accounting diverge"),
@@ -225,10 +236,10 @@ def check_remat(pcg, level: str, segment_size: int = 8,
                             else "")
                 out.append(Diagnostic(
                     rule_id="FF004", node=n.name,
-                    message=(f"consumes '{prod.name}' from remat block "
-                             f"{seg_of[g]} while living in earlier block "
-                             f"{seg_of[n.guid]}{stateful}: the cut runs "
-                             "against the topological order"),
+                    message=(f"consumes '{prod.name}' from {block} "
+                             f"{seg_of[g]} while living in earlier "
+                             f"{block} {seg_of[n.guid]}{stateful}: the "
+                             "cut runs against the topological order"),
                     fix_hint=RULES["FF004"].fix_hint))
     return out
 

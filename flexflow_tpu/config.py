@@ -91,6 +91,21 @@ class FFConfig:
     # target compute nodes per remat block (blocks cut at graph
     # bottlenecks; ~one transformer layer at the default)
     remat_segment_size: int = 8
+    # pipeline schedule (--schedule, ISSUE 10; docs/pipeline.md): "" lets
+    # the Unity search sweep the schedule axis; "gpipe"/"1f1b"/
+    # "interleaved" force one — the same flag-beats-searched precedence
+    # as --remat (parallel.pipeline.resolve_schedule)
+    schedule: str = ""
+    # virtual stage chunks per pipeline device for the interleaved
+    # schedule (Megatron interleaved-1F1B's v); 0 = default (2 when
+    # interleaved is chosen)
+    pipeline_virtual_stages: int = 0
+    # SPMD collective-compute overlap (--collective-overlap, ISSUE 10):
+    # "on" splits the step's gradient synchronization into per-remat-block
+    # psums issued as each block's backward completes (bitwise-identical
+    # loss/grads to the synchronous path — executor._blockwise_value_and_
+    # grad); "off" keeps the synchronous all-reduces at step end
+    collective_overlap: str = "off"
 
     # machine model for the simulator
     machine_model_version: int = 0
@@ -313,6 +328,21 @@ class FFConfig:
                 self.remat = v
             elif a == "--remat-segment-size":
                 self.remat_segment_size = int(_next())
+            elif a == "--schedule":
+                v = _next()
+                if v not in ("gpipe", "1f1b", "interleaved"):
+                    raise ValueError(
+                        f"--schedule expects gpipe|1f1b|interleaved, "
+                        f"got {v!r}")
+                self.schedule = v
+            elif a == "--virtual-stages":
+                self.pipeline_virtual_stages = int(_next())
+            elif a == "--collective-overlap":
+                v = _next()
+                if v not in ("on", "off"):
+                    raise ValueError(
+                        f"--collective-overlap expects on|off, got {v!r}")
+                self.collective_overlap = v
             elif a == "--overlap":
                 self.search_overlap_backward_update = True
             elif a == "--import" or a == "--import-strategy":
@@ -494,6 +524,17 @@ class FFConfig:
                 f"--decode-retry-budget must be >= 0 (got "
                 f"{self.decode_retry_budget}); 0 aborts a poisoned "
                 "request on its first quarantined decode")
+        if "--virtual-stages" in seen:
+            if self.pipeline_virtual_stages < 2:
+                raise ValueError(
+                    f"--virtual-stages must be >= 2 (got "
+                    f"{self.pipeline_virtual_stages}): v=1 IS the 1f1b "
+                    "schedule — drop the flag and use --schedule 1f1b")
+            if self.schedule != "interleaved":
+                raise ValueError(
+                    "--virtual-stages only applies to the interleaved "
+                    "schedule; add --schedule interleaved or drop "
+                    "--virtual-stages")
         if "--drift-tolerance" in seen and self.drift_tolerance <= 0:
             raise ValueError(
                 f"--drift-tolerance must be > 0 (got "

@@ -353,6 +353,107 @@ class Executor:
             values.update(zip(out_refs, outs))
         return values[(self.final_guid, self.final_out_idx)]
 
+    # --------------------------------------------- collective-compute overlap
+    def _blockwise_value_and_grad(self, program, params, xs, labels, rng,
+                                  cache):
+        """Forward + loss + grads over the remat block program with the
+        gradient synchronization SPLIT per block (``--collective-overlap
+        on``, ISSUE 10): each block's backward runs through its own
+        ``jax.vjp``, and as it completes its weight grads are (a) pinned to
+        their final shardings via ``with_sharding_constraint`` — the SPMD
+        partitioner materializes that block's grad all-reduce at this
+        program point instead of deferring every psum to the step tail —
+        and (b) coupled to the outgoing boundary cotangents through
+        ``lax.optimization_barrier``, so upstream blocks' backward compute
+        cannot be scheduled before the block's reduction is issuable: the
+        collectives hide behind the remaining backward instead of
+        serializing after it.
+
+        Numerics are IDENTICAL to the synchronous ``value_and_grad`` path:
+        the same block functions run in the same order, cotangents
+        accumulate in the same reverse-block order, the sharding
+        constraint and the barrier are value-identities, and each psum
+        happens exactly once on the same mesh — loss, grads, and the
+        updated params are bitwise-equal (tests/test_pipeline_schedules).
+        Returns ``((loss, (logits, cache_out)), grads)`` with ``grads``
+        matching the ``params`` pytree (blocks partition the layers)."""
+        import jax
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        cdtype = self._compute_jnp_dtype()
+        if cdtype is not None:
+            xs = self._cast_floats(xs, cdtype)
+        bound = self._bind_inputs(xs)
+        values: Dict[Tuple[int, int], Any] = {(g, 0): v
+                                              for g, v in bound.items()}
+        shardings = self.param_shardings() if self.mesh is not None else {}
+        tapes = []
+        aux_primals = []
+        cache_out: Dict[str, Any] = {}
+        for fn, ext_refs, out_refs, names, k, cache_names in program:
+            block_params = {n: params[n] for n in names if n in params}
+            ext_vals = tuple(values[r] for r in ext_refs)
+
+            def run(bp, ev, _fn=fn):
+                # the mixed-precision cast lives INSIDE the vjp, exactly
+                # as in the synchronous loss_fn: grads flow back to the
+                # float32 master params
+                if cdtype is not None:
+                    bp = self._cast_floats(bp, cdtype)
+                return _fn(bp, ev, rng, cache)
+
+            with jax.named_scope(f"remat_block_{k}"):
+                (outs, aux, cache_vals), vjp = jax.vjp(
+                    run, block_params, ext_vals)
+            aux_primals.append(aux)
+            cache_out.update(zip(cache_names, cache_vals))
+            values.update(zip(out_refs, outs))
+            tapes.append((vjp, ext_refs, out_refs, outs, aux, cache_vals))
+
+        raw = values[(self.final_guid, self.final_out_idx)]
+
+        def tail(r):
+            logits = self._logits_f32(r)
+            from .losses import loss_value
+
+            return loss_value(self.loss_type, logits, labels,
+                              self.repl_labels), logits
+
+        loss, tail_vjp, logits = jax.vjp(tail, raw, has_aux=True)
+        # aux losses add in block order, matching the synchronous path's
+        # `for aux in ctx.aux_losses: loss = loss + aux`
+        for aux in aux_primals:
+            loss = loss + aux
+
+        cot: Dict[Tuple[int, int], Any] = {}
+        (d_raw,) = tail_vjp(jnp.ones_like(loss))
+        cot[(self.final_guid, self.final_out_idx)] = d_raw
+        grads: Dict[str, Dict[str, Any]] = {}
+        for vjp, ext_refs, out_refs, outs, aux, cache_vals in \
+                reversed(tapes):
+            cots_outs = tuple(
+                cot.pop(r) if r in cot else jnp.zeros_like(o)
+                for r, o in zip(out_refs, outs))
+            dbp, dext = vjp((cots_outs, jnp.ones_like(aux),
+                             tuple(jnp.zeros_like(c) for c in cache_vals)))
+            # pin each weight grad to its final sharding — the psum
+            # happens HERE, overlappable with the upstream backward ...
+            if shardings:
+                dbp = {n: {w: (lax.with_sharding_constraint(
+                    g, shardings[n][w])
+                    if shardings.get(n, {}).get(w) is not None else g)
+                    for w, g in ws.items()} for n, ws in dbp.items()}
+            # ... and order it before the upstream blocks consume the
+            # boundary cotangents (a pure scheduling fence, value-identity)
+            dbp, dext = lax.optimization_barrier((dbp, dext))
+            grads.update(dbp)
+            for r, d in zip(ext_refs, dext):
+                prev = cot.get(r)
+                cot[r] = d if prev is None else jax.tree_util.tree_map(
+                    jnp.add, prev, d)
+        return (loss, (logits, cache_out)), grads
+
     # ----------------------------------------------------------- cache state
     def init_cache(self):
         """Zeroed cache-state pytree for the graph's CacheOps:
@@ -417,13 +518,22 @@ class Executor:
         from .remat import resolve_remat_plan
 
         plan = resolve_remat_plan(self.config, self.strategy)
+        # collective-compute overlap (ISSUE 10): per-remat-block grad
+        # psums issued as each block's backward completes, instead of the
+        # synchronous all-reduces at step end. Needs the block program
+        # even at remat level "none" (blocks stay unwrapped — the
+        # checkpoint policy is None — but give the backward its per-block
+        # sync points).
+        overlap = (getattr(self.config, "collective_overlap", "off")
+                   or "off") == "on"
         remat_program = None
-        if plan.level != "none":
+        if plan.level != "none" or overlap:
             # CacheOp graphs remat too (ISSUE 6 inversion of the old
             # opt-out): cache state threads through the checkpointed
             # blocks as explicit inputs/outputs
             remat_program = self._build_remat_program(plan)
-        self.remat_plan = plan if remat_program is not None else None
+        self.remat_plan = plan if (remat_program is not None
+                                   and plan.level != "none") else None
 
         def loss_fn(params, xs, labels, rng, cache):
             params_c, xs = self._cast_for_compute(params, xs)
@@ -446,8 +556,13 @@ class Executor:
             return loss, (logits, cache_out)
 
         def step(params, opt_state, xs, labels, rng, cache=None):
-            (loss, (logits, cache_out)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, xs, labels, rng, cache)
+            if overlap:
+                (loss, (logits, cache_out)), grads = \
+                    self._blockwise_value_and_grad(
+                        remat_program, params, xs, labels, rng, cache)
+            else:
+                (loss, (logits, cache_out)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, xs, labels, rng, cache)
             if guard:
                 import jax.numpy as jnp
 

@@ -720,15 +720,19 @@ class FFModel:
         self._pipeline_trainer = None
         if getattr(self.strategy, "pipeline", None):
             from .execution.remat import resolve_stage_remat
-            from .parallel.pipeline import PipelineTrainer
+            from .parallel.pipeline import PipelineTrainer, resolve_schedule
 
             pp, pdp, n_micro = self.strategy.pipeline
+            # schedule: --schedule flag > searched strategy.schedule >
+            # classic gpipe (parallel.pipeline.resolve_schedule)
+            sched, v = resolve_schedule(self.config, self.strategy)
             self._pipeline_trainer = PipelineTrainer(
                 self, pp=pp, dp=pdp, n_micro=n_micro,
                 optimizer=self.optimizer, loss_type=loss_type,
                 init_params=False,  # fit() seeds from the live params
                 # stage remat: --remat flag > searched level > GPipe full
-                remat=resolve_stage_remat(self.config, self.strategy))
+                remat=resolve_stage_remat(self.config, self.strategy),
+                schedule=sched, virtual_stages=v)
 
     def create_pcg(self):
         """Layer graph -> PCG (reference: create_operators_from_layers,
@@ -1199,9 +1203,17 @@ class FFModel:
             raise ValueError(
                 f"pipeline strategy needs batch_size % dp == 0 "
                 f"(batch {batch_size}, dp {tr.dp})")
-        tr.n_micro = next(m for m in (2 * tr.pp, tr.pp, 2, 1)
-                          if batch_size % m == 0 and
-                          (batch_size // m) % tr.dp == 0)
+        micro_ok = [m for m in (2 * tr.pp, tr.pp, 2, 1)
+                    if batch_size % m == 0 and
+                    (batch_size // m) % tr.dp == 0 and
+                    # interleaved advances microbatches in rounds of pp
+                    (tr.schedule != "interleaved" or m % tr.pp == 0)]
+        if not micro_ok:
+            raise ValueError(
+                f"pipeline schedule {tr.schedule!r} found no microbatch "
+                f"count for batch_size {batch_size} (pp={tr.pp}, "
+                f"dp={tr.dp}); use a batch divisible by pp*dp")
+        tr.n_micro = micro_ok[0]
         loss_key = {
             LossType.LOSS_CATEGORICAL_CROSSENTROPY: "cce_loss",
             LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:

@@ -1,4 +1,4 @@
-"""Pipeline parallelism: stage-partitioned GPipe training over submeshes.
+"""Pipeline parallelism: stage-partitioned pipeline training over submeshes.
 
 The reference reserves the vocabulary but ships nothing: ``OP_PIPELINE`` is an
 enum + task IDs only (ffconst.h:159, model.h:191-193; SURVEY §2.3 "pipeline
@@ -8,16 +8,34 @@ reference parity with a working TPU-native design:
 * ``split_stages``: contiguous, flops-balanced partition of the PCG's compute
   nodes (cuts preferentially at graph bottlenecks, found via the same
   immediate-post-dominator machinery the reference's sequence splits use).
-* ``PipelineTrainer``: GPipe schedule — the global batch is split into
-  microbatches; each stage lives on its own submesh of a (pipe, data) device
-  grid, with data parallelism inside the stage. Stage backward runs through
-  a leveled ``jax.checkpoint`` policy (``remat=`` none|selective|full,
-  execution/remat.py — the same machinery as the Executor's remat blocks);
-  ``full`` is the classic GPipe recompute-the-stage recipe and the default.
-  Stage-boundary activations move between submeshes via ``jax.device_put``
-  (ICI transfers on real hardware); JAX's async dispatch overlaps microbatch
-  k's stage-s compute with microbatch k+1's stage-(s-1) compute — the GPipe
-  bubble is the only serialization, exactly as in the paper.
+* ``pipeline_schedule``: the schedule generator — ONE source of the
+  (phase, microbatch, chunk) execution order for all three schedules
+  (``gpipe`` fill/drain, ``1f1b`` PipeDream-flush, ``interleaved``
+  Megatron-style virtual chunks), consumed both by the trainer's host
+  dispatch loop below and by the simulator's task-graph makespan
+  (search/unity.py) — the simulator prices exactly the order the trainer
+  runs (the repo's one-artifact-two-consumers rule, like remat segments).
+* ``PipelineTrainer``: the global batch is split into microbatches; each
+  stage chunk lives on a submesh of a (pipe, data) device grid, with data
+  parallelism inside the stage. ``schedule=`` selects the step
+  orchestration: ``gpipe`` forwards every microbatch then drains the
+  backwards (in-flight boundary activations scale with ``n_micro``);
+  ``1f1b`` interleaves microbatch k's backward with microbatch k+pp's
+  forward in steady state, capping in-flight activations at ``pp``
+  (Narayanan et al., SOSP'19); ``interleaved`` assigns ``v`` virtual stage
+  chunks per device round-robin (chunk c on device c % pp, Narayanan et
+  al., SC'21), shrinking the pipeline bubble by ~v at a boundary-traffic
+  premium. Grad accumulation order (ascending microbatch per chunk) and
+  the microbatch-mean update are IDENTICAL across schedules — same stage
+  functions, same dispatches, different interleaving — so gpipe and 1f1b
+  updates are bitwise-equal (tests/test_pipeline_schedules.py).
+  Stage backward runs through a leveled ``jax.checkpoint`` policy
+  (``remat=`` none|selective|full, execution/remat.py — the same machinery
+  as the Executor's remat blocks); ``full`` is the classic GPipe
+  recompute-the-stage recipe and the default. Stage-boundary activations
+  move between submeshes via ``jax.device_put`` (ICI transfers on real
+  hardware); JAX's async dispatch overlaps the schedule's concurrent
+  tasks — the schedule's bubble is the only serialization.
 
 Gradient semantics match non-pipelined training: with equal microbatches and
 mean-reduced losses, the mean of microbatch gradients equals the full-batch
@@ -35,6 +53,213 @@ from ..ffconst import LossType, OperatorType, dtype_to_jnp
 from .pcg import PCG, PCGNode
 
 BoundaryT = Tuple[int, int]  # (guid, out_idx)
+
+# the searched schedule axis (docs/pipeline.md); order = sweep order in
+# search/unity.py's pipeline candidates
+PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+def resolve_schedule(config, strategy) -> Tuple[str, int]:
+    """(schedule, virtual_stages) the trainer runs: the ``--schedule`` flag
+    wins, then the searched ``strategy.schedule``, then the classic
+    ``gpipe``. ``virtual_stages`` (v) is only meaningful for
+    ``interleaved`` (``--virtual-stages`` flag > searched value > 2) and is
+    pinned to 1 for the single-chunk schedules."""
+    sched = (getattr(config, "schedule", "") or "").strip() or \
+        (getattr(strategy, "schedule", "") or "") or "gpipe"
+    if sched not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"schedule {sched!r} not in {PIPELINE_SCHEDULES}")
+    if sched != "interleaved":
+        return sched, 1
+    v = int(getattr(config, "pipeline_virtual_stages", 0) or 0)
+    if v < 2:
+        sv = int(getattr(strategy, "virtual_stages", 0) or 0)
+        v = sv if sv >= 2 else 2
+    return sched, v
+
+
+def describe_schedule(schedule: str, v: int = 1) -> str:
+    """The one display rule for a schedule suffix: '' for gpipe/unset
+    (the default needs no annotation), the schedule name otherwise, with
+    the interleaved virtual-chunk count appended ('interleaved(v=2)').
+    Shared by Strategy.describe, RankedCandidate.describe and
+    trace_summary so the three renderings cannot drift."""
+    if not schedule or schedule == "gpipe":
+        return ""
+    if schedule == "interleaved" and int(v or 1) > 1:
+        return f"{schedule}(v={v})"
+    return schedule
+
+
+def pipeline_schedule(schedule: str, pp: int, n_micro: int, v: int = 1
+                      ) -> List[Tuple[str, int, int]]:
+    """The (phase, microbatch, chunk) execution order of one training step,
+    phase in {"F", "B"}; chunk c executes on pipeline device c % pp.
+
+    The returned sequence is a valid topological order of the microbatch
+    dataflow (F(m,c) after F(m,c-1); B(m,c) after F(m,c) and B(m,c+1)),
+    and its per-device projection IS the schedule's device-local order —
+    the two properties the trainer's async host dispatch and the
+    simulator's per-device order chains respectively rely on.
+
+    ``gpipe`` is the closed-form fill/drain. ``1f1b``/``interleaved`` come
+    out of a unit-cost list-scheduling pass with backward-first,
+    oldest-microbatch-first device priority: with one chunk per device
+    that greedy IS PipeDream-flush 1F1B (a backward becomes runnable
+    exactly pp tasks after its forward and preempts younger forwards);
+    with v chunks per device it yields the interleaved order (microbatch
+    m's chunk c+pp forward becomes ready before microbatch m+pp's chunk
+    c). Per chunk, backwards run in ascending microbatch order in every
+    schedule — the property that keeps grad accumulation bitwise-stable
+    across schedules."""
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"schedule {schedule!r} not in {PIPELINE_SCHEDULES}")
+    n_chunks = pp * (v if schedule == "interleaved" else 1)
+    if schedule == "gpipe":
+        ev = [("F", m, c) for m in range(n_micro) for c in range(n_chunks)]
+        ev += [("B", m, c) for m in range(n_micro)
+               for c in reversed(range(n_chunks))]
+        return ev
+
+    last = n_chunks - 1
+    deps: Dict[Tuple[str, int, int], List[Tuple[str, int, int]]] = {}
+    for m in range(n_micro):
+        for c in range(n_chunks):
+            deps[("F", m, c)] = [("F", m, c - 1)] if c else []
+            d = [("F", m, c)]
+            if c < last:
+                d.append(("B", m, c + 1))
+            deps[("B", m, c)] = d
+
+    if schedule == "interleaved":
+        if n_micro % pp:
+            raise ValueError(
+                f"interleaved schedule needs n_micro % pp == 0 "
+                f"(n_micro={n_micro}, pp={pp}): microbatches advance in "
+                "rounds of pp through the virtual chunks — use 1f1b, or "
+                "a microbatch count the pipeline depth divides")
+        orders = [_interleaved_device_order(pp, d, n_micro, v)
+                  for d in range(pp)]
+        return _merge_device_orders(orders, deps)
+
+    # 1f1b: unit-cost list scheduling with backward-first priority AND the
+    # in-flight cap that makes 1F1B 1F1B — device d may hold at most
+    # pp - d microbatches awaiting backward (the PipeDream-flush warmup
+    # depth); past the cap it IDLES for its next backward instead of
+    # issuing a younger forward. Without the cap a greedy fills stalls
+    # with forwards and early stages balloon to ~2pp in-flight — exactly
+    # the gpipe memory behavior the schedule exists to avoid. The cap is
+    # what pipeline_in_flight charges and the trainer's
+    # release-after-backward then actually holds.
+    pending: List[List[Tuple[str, int, int]]] = [[] for _ in range(pp)]
+    for t in deps:
+        pending[t[2] % pp].append(t)
+    done_round: Dict[Tuple[str, int, int], int] = {}
+    outstanding = [0] * pp  # forwards issued minus backwards completed
+    order: List[Tuple[str, int, int]] = []
+    total = len(deps)
+    rnd = 0
+    while len(order) < total:
+        if rnd > 2 * total + n_chunks:  # loop guard, not an assert: a
+            # stalled generator under python -O must fail loudly, not hang
+            raise RuntimeError(
+                f"pipeline schedule generator stalled "
+                f"({schedule}, pp={pp}, n_micro={n_micro}, v={v})")
+        for dev in range(pp):
+            cap = pp - dev
+            ready = [t for t in pending[dev]
+                     if all(done_round.get(x, rnd) < rnd
+                            for x in deps[t])
+                     and (t[0] == "B" or outstanding[dev] < cap)]
+            if not ready:
+                continue
+            # backward-first (the 1F1B rule), then oldest microbatch
+            t = min(ready, key=lambda tk: (tk[0] != "B", tk[1], tk[2]))
+            pending[dev].remove(t)
+            done_round[t] = rnd
+            outstanding[dev] += 1 if t[0] == "F" else -1
+            order.append(t)
+        rnd += 1
+    return order
+
+
+def _interleaved_device_order(pp: int, d: int, n_micro: int, v: int
+                              ) -> List[Tuple[str, int, int]]:
+    """Device d's canonical interleaved-1F1B order (Narayanan et al.,
+    SC'21; Megatron-LM's forward_backward_pipelining_with_interleaving):
+    microbatches advance in rounds of pp through the v virtual chunks —
+    forward unit i maps to chunk ((i // pp) % v) of microbatch
+    ((i // (pp*v)) * pp + i % pp); backwards mirror with the chunk order
+    reversed. Warmup depth (pp - d - 1)*2 + (v - 1)*pp forward units, then
+    steady 1F1B alternation, then the cooldown backwards. Chunk c here is
+    the GLOBAL chunk id k*pp + d of the device's k-th virtual chunk."""
+    N = n_micro * v
+
+    def f_unit(i: int) -> Tuple[str, int, int]:
+        k = (i // pp) % v
+        m = (i // (pp * v)) * pp + i % pp
+        return ("F", m, k * pp + d)
+
+    def b_unit(j: int) -> Tuple[str, int, int]:
+        k = v - 1 - (j // pp) % v
+        m = (j // (pp * v)) * pp + j % pp
+        return ("B", m, k * pp + d)
+
+    warmup = min((pp - d - 1) * 2 + (v - 1) * pp, N)
+    seq = [f_unit(i) for i in range(warmup)]
+    for j in range(N - warmup):
+        seq.append(f_unit(warmup + j))
+        seq.append(b_unit(j))
+    seq.extend(b_unit(j) for j in range(N - warmup, N))
+    return seq
+
+
+def _merge_device_orders(orders: List[List[Tuple[str, int, int]]],
+                         deps: Dict[Tuple[str, int, int],
+                                    List[Tuple[str, int, int]]]
+                         ) -> List[Tuple[str, int, int]]:
+    """Linearize per-device orders into one global sequence that is a
+    valid topological order of ``deps`` while preserving every device's
+    relative order (what the trainer's per-device FIFO dispatch needs)."""
+    order: List[Tuple[str, int, int]] = []
+    emitted = set()
+    idx = [0] * len(orders)
+    total = sum(len(o) for o in orders)
+    while len(order) < total:
+        progressed = False
+        for d, seq in enumerate(orders):
+            while idx[d] < len(seq):
+                t = seq[idx[d]]
+                if any(x not in emitted for x in deps[t]):
+                    break
+                order.append(t)
+                emitted.add(t)
+                idx[d] += 1
+                progressed = True
+        if not progressed:  # loop guard, not an assert (python -O)
+            raise RuntimeError("interleaved device orders deadlocked")
+    return order
+
+
+def pipeline_in_flight(schedule: str, pp: int, n_micro: int, v: int = 1
+                       ) -> int:
+    """Peak in-flight microbatches per pipeline device under ``schedule`` —
+    how many microbatches' boundary activations a device holds awaiting
+    backward. THE shared memory-accounting term: the trainer retains
+    exactly this many (it releases a microbatch's stage inputs/outputs as
+    its backward completes) and ``simulate_pipeline`` charges exactly this
+    many (docs/pipeline.md). ``gpipe`` drains nothing until the flush
+    (n_micro); ``1f1b`` caps at the pipeline depth pp; ``interleaved``
+    pays an extra ~pp/v of warmup depth for its shorter fill:
+    pp*(2v-1)/v, which degenerates to pp at v=1."""
+    if schedule == "gpipe":
+        return max(n_micro, 1)
+    if schedule == "1f1b":
+        return max(min(pp, n_micro), 1)
+    v = max(v, 1)
+    return max(min((pp * (2 * v - 1) + v - 1) // v, n_micro), 1)
 
 
 def split_stages(pcg: PCG, n_stages: int) -> List[List[int]]:
@@ -185,14 +410,15 @@ def build_stage_specs(pcg: PCG, stages: List[List[int]]) -> List[StageSpec]:
 
 
 class PipelineTrainer:
-    """GPipe training of an FFModel over a (pipe, data) device grid.
+    """Pipeline training of an FFModel over a (pipe, data) device grid.
 
     Usage::
 
         ff = FFModel(config); ...build layers...; ff.compile(...)  # optional
         trainer = PipelineTrainer(ff, pp=4, dp=2, n_micro=8,
                                   optimizer=AdamOptimizer(ff),
-                                  loss_type=LossType...)
+                                  loss_type=LossType...,
+                                  schedule="1f1b")
         loss = trainer.train_step(x_batch, y_batch)
     """
 
@@ -201,7 +427,8 @@ class PipelineTrainer:
                  loss_type: LossType =
                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                  devices: Optional[Sequence] = None,
-                 init_params: bool = True, remat: str = "full"):
+                 init_params: bool = True, remat: str = "full",
+                 schedule: str = "gpipe", virtual_stages: int = 1):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -210,22 +437,49 @@ class PipelineTrainer:
 
         if remat not in REMAT_LEVELS:
             raise ValueError(f"remat {remat!r} not in {REMAT_LEVELS}")
+        if schedule not in PIPELINE_SCHEDULES:
+            raise ValueError(
+                f"schedule {schedule!r} not in {PIPELINE_SCHEDULES}")
+        v = int(virtual_stages or 1)
+        if schedule == "interleaved":
+            if v < 2:
+                raise ValueError(
+                    f"interleaved schedule needs virtual_stages >= 2 "
+                    f"(got {v}); v=1 IS the 1f1b schedule — use "
+                    "schedule='1f1b'")
+        elif v != 1:
+            raise ValueError(
+                f"virtual_stages={v} only applies to the interleaved "
+                f"schedule (got schedule={schedule!r})")
         # stage-remat level: the SAME jax.checkpoint policy machinery the
         # Executor's remat blocks use (execution/remat.py) — `full` is the
         # classic GPipe recipe this trainer previously hard-coded as a
         # hand-rolled VJP; `selective` keeps contraction outputs across the
         # stage backward; `none` saves every stage residual in-jit
         self.remat = remat
+        self.schedule = schedule
+        self.v = v
         self.loss_type = loss_type
         self.pp, self.dp = pp, dp
         self.n_micro = n_micro or pp
         self.optimizer = optimizer or SGDOptimizer(None)
 
         pcg = ffmodel.pcg if ffmodel.pcg is not None else ffmodel.create_pcg()
-        # pipeline over the PRE-fusion graph for clean stage cuts
+        # pipeline over the PRE-fusion graph for clean stage cuts; the
+        # interleaved schedule cuts pp*v chunks and lays them round-robin
+        # over the pp device rows (chunk c on row c % pp)
         self.pcg = pcg
-        self.stages = split_stages(pcg, pp)
+        self.n_chunks = pp * v
+        n_nodes = len(pcg.compute_nodes())
+        if self.n_chunks > n_nodes:
+            raise ValueError(
+                f"schedule {schedule!r} needs pp*v = {pp}*{v} = "
+                f"{self.n_chunks} stage chunks but the graph has only "
+                f"{n_nodes} compute nodes; lower --virtual-stages (v) "
+                "or the pipeline depth")
+        self.stages = split_stages(pcg, self.n_chunks)
         self.specs = build_stage_specs(pcg, self.stages)
+        self.chunk_dev = [c % pp for c in range(self.n_chunks)]
         self.model_input_order = [n.guid for n in pcg.input_nodes()]
         final = [n for n in pcg.sinks()
                  if n.op.op_type != OperatorType.OP_INPUT][-1]
@@ -236,12 +490,23 @@ class PipelineTrainer:
         assert len(devices) >= pp * dp, \
             f"need {pp * dp} devices, have {len(devices)}"
         grid = np.array(devices[:pp * dp]).reshape(pp, dp)
-        self.meshes = [Mesh(grid[s], ("data",)) for s in range(pp)]
+        self.meshes = [Mesh(grid[d], ("data",)) for d in range(pp)]
         self.batch_shardings = [
-            NamedSharding(self.meshes[s], P("data"))
-            for s in range(pp)]
+            NamedSharding(self.meshes[d], P("data"))
+            for d in range(pp)]
+        # microbatch-stacked host inputs: (n_micro, mb, ...) sharded
+        # (None, "data") so per-microbatch rows slice ON DEVICE — one
+        # host->device transfer per (chunk, feed) per step, not n_micro
+        self.micro_shardings = [
+            NamedSharding(self.meshes[d], P(None, "data"))
+            for d in range(pp)]
         self._P = P
         self._NamedSharding = NamedSharding
+        # event-order memo keyed by n_micro (fit() re-derives n_micro per
+        # real batch): the generator is pure host-side Python — rebuilding
+        # the 1f1b greedy every step would put dead O(events^2) work in
+        # the dispatch loop the async pipeline is meant to hide
+        self._order_cache: Dict[int, List[Tuple[str, int, int]]] = {}
 
         self._build_stage_fns()
         if init_params:
@@ -388,10 +653,10 @@ class PipelineTrainer:
                             sub_key, shape, dtype_to_jnp(dt))
                 return out
 
-            with self.meshes[s]:
+            with self.meshes[self.chunk_dev[s]]:
                 p = jax.jit(init_fn)(jax.random.PRNGKey(0))
             p = jax.device_put(p, self._NamedSharding(
-                self.meshes[s], self._P()))
+                self.meshes[self.chunk_dev[s]], self._P()))
             params.append(p)
         return params
 
@@ -406,7 +671,8 @@ class PipelineTrainer:
                      if n.op.op_type != OperatorType.OP_INPUT}
             p = {k: v for k, v in full_params.items() if k in names}
             new.append(jax.device_put(
-                p, self._NamedSharding(self.meshes[s], self._P())))
+                p, self._NamedSharding(self.meshes[self.chunk_dev[s]],
+                                       self._P())))
         self.params = new
         self.opt_states = [self.optimizer.init_state(p) for p in self.params]
 
@@ -421,95 +687,152 @@ class PipelineTrainer:
         return out
 
     # ---------------------------------------------------------------- train
-    def _microbatches(self, arrays: List[np.ndarray]) -> List[List[Any]]:
-        n = arrays[0].shape[0]
+    def _stacked_inputs(self, arrays: List[Any]):
+        """One host->device transfer per (chunk, feed): the full input
+        arrays go up microbatch-major ``(n_micro, mb, ...)`` sharded
+        ``(None, "data")`` — each dp shard then slices its OWN microbatch
+        rows on device (no cross-device traffic, no per-(microbatch,
+        stage, feed) ``device_put`` of host-sliced numpy — the old host
+        loop paid n_micro * stages transfers per step)."""
+        import jax
+
+        n = int(np.asarray(arrays[0]).shape[0])
         mb = n // self.n_micro
         assert mb * self.n_micro == n, \
             f"batch {n} not divisible by n_micro {self.n_micro}"
         assert mb % self.dp == 0, f"microbatch {mb} not divisible by dp"
-        return [[a[m * mb:(m + 1) * mb] for a in arrays]
-                for m in range(self.n_micro)]
+        feed_arrays = dict(zip(self.model_input_order, arrays[:-1]))
+        stacked: Dict[Tuple[int, int], Any] = {}
+        for c, spec in enumerate(self.specs):
+            dev = self.chunk_dev[c]
+            for feed in spec.feeds:
+                if feed[0] != "model":
+                    continue
+                a = np.asarray(feed_arrays[feed[1]])
+                stacked[(c, feed[1])] = jax.device_put(
+                    a.reshape((self.n_micro, mb) + a.shape[1:]),
+                    self.micro_shardings[dev])
+        lab = np.asarray(arrays[-1])
+        labels = jax.device_put(
+            lab.reshape((self.n_micro, mb) + lab.shape[1:]),
+            self.micro_shardings[self.chunk_dev[len(self.specs) - 1]])
+        return stacked, labels
 
     def train_step(self, x, y, rng_seed: int = 0) -> float:
-        """One GPipe step: forward all microbatches through all stages,
-        backward in reverse, accumulate grads, apply the optimizer."""
+        """One pipelined step in ``self.schedule``'s order: forwards and
+        backwards interleave per :func:`pipeline_schedule`, grads
+        accumulate per chunk in ascending microbatch order (bitwise-stable
+        across schedules), then the microbatch-mean update applies. A
+        microbatch's boundary activations are RELEASED as its backward
+        completes — in-flight activation memory follows
+        :func:`pipeline_in_flight` (n_micro for gpipe, ~pp for 1f1b)."""
         import jax
         import jax.numpy as jnp
 
+        from ..obs import get_tracer
+
         xs = x if isinstance(x, (list, tuple)) else [x]
-        micro = self._microbatches(list(xs) + [y])
+        stacked, labels = self._stacked_inputs(list(xs) + [y])
         S = len(self.specs)
         key = jax.random.PRNGKey(rng_seed)
+        tracer = get_tracer()
+        trace = tracer.enabled
 
-        # ---- forward (fill): stage outputs per (microbatch, stage)
-        stage_ins: List[List[Tuple]] = [[None] * S for _ in range(self.n_micro)]
-        stage_outs: List[List[Tuple]] = [[None] * S
-                                         for _ in range(self.n_micro)]
-        losses = []
-        labels_per_m = []
-        for m, arrays in enumerate(micro):
-            feed_arrays = dict(zip(self.model_input_order, arrays[:-1]))
-            labels_per_m.append(arrays[-1])
-            mkey = jax.random.fold_in(key, m)
-            for s in range(S):
-                ins = []
-                for feed in self.specs[s].feeds:
-                    if feed[0] == "model":
-                        v = jax.device_put(feed_arrays[feed[1]],
-                                           self.batch_shardings[s])
-                    else:
-                        _, src_stage, out_pos = feed
-                        v = stage_outs[m][src_stage][out_pos]
-                        if src_stage != s:  # cross-submesh transfer
-                            v = jax.device_put(
-                                v, self.batch_shardings[s])
-                    ins.append(v)
-                ins = tuple(ins)
-                stage_ins[m][s] = ins
-                if s < S - 1:
-                    stage_outs[m][s] = self._fwd[s](
-                        self.params[s], ins, mkey)
-                # last stage forward happens fused with backward below
-
-        # ---- backward (drain): reverse stage order per microbatch
+        stage_ins: Dict[Tuple[int, int], Tuple] = {}   # (m, chunk) -> ins
+        stage_outs: Dict[Tuple[int, int], Tuple] = {}
+        # (m, src_chunk, out_pos) -> accumulated cotangent
+        cots: Dict[Tuple[int, int, int], Any] = {}
         grad_acc: List[Any] = [None] * S
-        for m in range(self.n_micro):
+        acc_m: List[int] = [0] * S  # per-chunk microbatch accumulation cursor
+        losses = []
+
+        def add_cot(m, src_chunk, out_pos, val):
+            # accumulate on the PRODUCING chunk's submesh so
+            # multi-consumer adds colocate
+            val = jax.device_put(
+                val, self.batch_shardings[self.chunk_dev[src_chunk]])
+            prev = cots.get((m, src_chunk, out_pos))
+            cots[(m, src_chunk, out_pos)] = val if prev is None else \
+                jax.tree_util.tree_map(jnp.add, prev, val)
+
+        def gather_ins(m, c):
+            ins = []
+            for feed in self.specs[c].feeds:
+                if feed[0] == "model":
+                    ins.append(stacked[(c, feed[1])][m])
+                else:
+                    _, src_chunk, out_pos = feed
+                    val = stage_outs[(m, src_chunk)][out_pos]
+                    if self.chunk_dev[src_chunk] != self.chunk_dev[c]:
+                        # cross-submesh boundary transfer (ICI on hardware)
+                        val = jax.device_put(
+                            val, self.batch_shardings[self.chunk_dev[c]])
+                    ins.append(val)
+            return tuple(ins)
+
+        order = self._order_cache.get(self.n_micro)
+        if order is None:
+            order = self._order_cache[self.n_micro] = pipeline_schedule(
+                self.schedule, self.pp, self.n_micro, self.v)
+        for phase, m, c in order:
             mkey = jax.random.fold_in(key, m)
-            labels = jax.device_put(labels_per_m[m],
-                                    self.batch_shardings[S - 1])
-            loss, logits, dparams, dins = self._bwd[S - 1](
-                self.params[S - 1], stage_ins[m][S - 1], labels, mkey)
-            losses.append(loss)
-            grad_acc[S - 1] = dparams if grad_acc[S - 1] is None else \
-                jax.tree_util.tree_map(jnp.add, grad_acc[S - 1], dparams)
-            # cotangents flow back through earlier stages; accumulate on the
-            # PRODUCING stage's submesh so multi-consumer adds colocate
-            cots: Dict[Tuple[int, int], Any] = {}
-
-            def add_cot(src_stage, out_pos, val):
-                val = jax.device_put(val, self.batch_shardings[src_stage])
-                prev = cots.get((src_stage, out_pos))
-                cots[(src_stage, out_pos)] = val if prev is None else \
-                    jax.tree_util.tree_map(jnp.add, prev, val)
-
-            for pos, feed in enumerate(self.specs[S - 1].feeds):
-                if feed[0] == "stage":
-                    add_cot(feed[1], feed[2], dins[pos])
-            for s in range(S - 2, -1, -1):
+            if phase == "F":
+                stage_ins[(m, c)] = gather_ins(m, c)
+                if c == S - 1:
+                    continue  # last chunk's forward fuses with its backward
+                if trace:
+                    # per-(microbatch, stage, phase) spans: block so the
+                    # span is the stage's real wall and the Perfetto
+                    # timeline shows the bubble (observer effect: tracing
+                    # serializes the async dispatch — docs/pipeline.md)
+                    with tracer.span("pipeline_fwd", micro=m, stage=c,
+                                     device=self.chunk_dev[c],
+                                     schedule=self.schedule):
+                        out = self._fwd[c](self.params[c],
+                                           stage_ins[(m, c)], mkey)
+                        jax.block_until_ready(out)
+                else:
+                    out = self._fwd[c](self.params[c], stage_ins[(m, c)],
+                                       mkey)
+                stage_outs[(m, c)] = out
+                continue
+            # ---- backward of (m, c)
+            def run_bwd():
+                if c == S - 1:
+                    loss, _logits, dp_, di_ = self._bwd[c](
+                        self.params[c], stage_ins[(m, c)], labels[m], mkey)
+                    losses.append(loss)
+                    return dp_, di_
                 out_cots = []
-                for out_pos in range(len(self.specs[s].outputs)):
-                    c = cots.get((s, out_pos))
-                    # every exposed output has a later-stage consumer whose
-                    # backward already ran
-                    assert c is not None, (s, out_pos)
-                    out_cots.append(c)
-                dparams, dins = self._bwd[s](
-                    self.params[s], stage_ins[m][s], mkey, tuple(out_cots))
-                grad_acc[s] = dparams if grad_acc[s] is None else \
-                    jax.tree_util.tree_map(jnp.add, grad_acc[s], dparams)
-                for pos, feed in enumerate(self.specs[s].feeds):
-                    if feed[0] == "stage":
-                        add_cot(feed[1], feed[2], dins[pos])
+                for out_pos in range(len(self.specs[c].outputs)):
+                    # every exposed output has a later-chunk consumer whose
+                    # backward already ran (the schedule's B(m,c+1) chain)
+                    out_cots.append(cots.pop((m, c, out_pos)))
+                return self._bwd[c](self.params[c], stage_ins[(m, c)],
+                                    mkey, tuple(out_cots))
+
+            if trace:
+                with tracer.span("pipeline_bwd", micro=m, stage=c,
+                                 device=self.chunk_dev[c],
+                                 schedule=self.schedule):
+                    dparams, dins = run_bwd()
+                    jax.block_until_ready(dparams)
+            else:
+                dparams, dins = run_bwd()
+            # ascending-microbatch accumulation per chunk: the invariant
+            # every schedule preserves, keeping the grad sums bitwise-equal
+            # across gpipe/1f1b/interleaved
+            assert acc_m[c] == m, (self.schedule, c, m, acc_m[c])
+            acc_m[c] += 1
+            grad_acc[c] = dparams if grad_acc[c] is None else \
+                jax.tree_util.tree_map(jnp.add, grad_acc[c], dparams)
+            for pos, feed in enumerate(self.specs[c].feeds):
+                if feed[0] == "stage":
+                    add_cot(m, feed[1], feed[2], dins[pos])
+            # release the microbatch's boundary activations: this is the
+            # schedule's memory lever (pipeline_in_flight)
+            stage_ins.pop((m, c), None)
+            stage_outs.pop((m, c), None)
 
         # ---- update: mean of microbatch grads == full-batch grad
         inv = 1.0 / self.n_micro

@@ -46,6 +46,15 @@ class Strategy:
     # GPipe pipeline selected by the search: (pp, dp, n_micro). Training
     # routes through parallel.pipeline.PipelineTrainer; None = pure SPMD.
     pipeline: Optional[Tuple[int, int, int]] = None
+    # pipeline schedule the search chose (ISSUE 10): gpipe | 1f1b |
+    # interleaved, or "" = unset (strategy predates the schedule axis /
+    # was not searched — the trainer then runs the classic gpipe
+    # fill-drain). Only meaningful when ``pipeline`` is set; ``--schedule``
+    # overrides either way (parallel.pipeline.resolve_schedule).
+    schedule: str = ""
+    # virtual stage chunks per pipeline device for the interleaved
+    # schedule (Megatron interleaved-1F1B's v); 1 for gpipe/1f1b
+    virtual_stages: int = 1
     # activation-rematerialization level the search chose (ISSUE 3):
     # none | selective | full, or "" = unset (strategy predates the remat
     # axis / was not searched). The distinction matters: an explicit
@@ -69,6 +78,11 @@ class Strategy:
         bits = [f"mesh={tuple(self.mesh_shape)}"]
         if self.pipeline:
             bits.append(f"pipeline={tuple(self.pipeline)}")
+            from .pipeline import describe_schedule
+
+            sched = describe_schedule(self.schedule, self.virtual_stages)
+            if sched:
+                bits.append(f"schedule={sched}")
         if self.remat and self.remat != "none":
             bits.append(f"remat={self.remat}")
         if self.hybrid:
@@ -82,6 +96,8 @@ class Strategy:
             "axis_names": list(self.axis_names),
             "data_axis": self.data_axis,
             "pipeline": list(self.pipeline) if self.pipeline else None,
+            "schedule": self.schedule,
+            "virtual_stages": self.virtual_stages,
             "remat": self.remat,
             "hybrid": [list(self.hybrid[0]), list(self.hybrid[1])]
             if self.hybrid else None,
@@ -110,6 +126,8 @@ class Strategy:
                      data_axis=d.get("data_axis", "data"),
                      pipeline=tuple(d["pipeline"])
                      if d.get("pipeline") else None,
+                     schedule=d.get("schedule", "") or "",
+                     virtual_stages=int(d.get("virtual_stages", 1) or 1),
                      remat=d.get("remat", "") or "",
                      hybrid=(tuple(d["hybrid"][0]), tuple(d["hybrid"][1]))
                      if d.get("hybrid") else None)

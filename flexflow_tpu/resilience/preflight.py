@@ -74,6 +74,23 @@ def preflight_config(config) -> None:
     if remat and remat not in ("none", "selective", "full"):
         raise PreflightError(
             f"--remat expects none|selective|full, got {remat!r}")
+    sched = (getattr(config, "schedule", "") or "")
+    if sched and sched not in ("gpipe", "1f1b", "interleaved"):
+        raise PreflightError(
+            f"--schedule expects gpipe|1f1b|interleaved, got {sched!r}")
+    vstages = int(getattr(config, "pipeline_virtual_stages", 0) or 0)
+    if vstages and vstages < 2:
+        raise PreflightError(
+            f"--virtual-stages must be >= 2 (got {vstages}): v=1 IS the "
+            "1f1b schedule — use --schedule 1f1b instead")
+    if vstages and sched and sched != "interleaved":
+        raise PreflightError(
+            "--virtual-stages only applies to the interleaved schedule; "
+            "use --schedule interleaved or drop --virtual-stages")
+    co = (getattr(config, "collective_overlap", "off") or "off")
+    if co not in ("on", "off"):
+        raise PreflightError(
+            f"--collective-overlap expects on|off, got {co!r}")
     sa = (getattr(config, "static_analysis", "on") or "on")
     if sa not in ("on", "off", "strict"):
         raise PreflightError(
@@ -144,6 +161,17 @@ def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int,
         raise PreflightError(
             f"strategy remat level {strategy.remat!r} is not one of "
             "none|selective|full")
+    sched = (getattr(strategy, "schedule", "") or "")
+    vstages = int(getattr(strategy, "virtual_stages", 1) or 1)
+    if sched and sched not in ("gpipe", "1f1b", "interleaved"):
+        raise PreflightError(
+            f"strategy schedule {sched!r} is not one of "
+            "gpipe|1f1b|interleaved")
+    if sched and not strategy.pipeline:
+        raise PreflightError(
+            f"strategy sets schedule={sched!r} without a pipeline grid: "
+            "the schedule knob orders pipeline microbatches — add "
+            "pipeline=(pp, dp, n_micro) or drop the schedule")
     if strategy.pipeline:
         pp, pdp, micro = (int(v) for v in strategy.pipeline)
         if pp < 2:
@@ -160,6 +188,35 @@ def preflight_strategy(pcg, strategy, n_dev: int, batch_size: int,
                 f"pipeline grid {strategy.pipeline}: batch {batch_size} "
                 f"must split into {micro} microbatches each divisible by "
                 f"dp={pdp}")
+        # (schedule, pp, n_micro, v) combos (ISSUE 10, docs/pipeline.md):
+        # each failure names the knob to change
+        if sched == "interleaved":
+            if vstages < 2:
+                raise PreflightError(
+                    f"interleaved schedule needs virtual_stages >= 2 "
+                    f"(got {vstages}); virtual_stages=1 IS the 1f1b "
+                    "schedule — set schedule='1f1b' or raise "
+                    "virtual_stages")
+            if micro % pp:
+                raise PreflightError(
+                    f"interleaved schedule: n_micro={micro} must be a "
+                    f"multiple of pp={pp} (microbatches advance in "
+                    "rounds of pp through the virtual chunks) — change "
+                    "n_micro or use schedule='1f1b'")
+        elif vstages != 1:
+            raise PreflightError(
+                f"virtual_stages={vstages} only applies to the "
+                f"interleaved schedule (got schedule="
+                f"{sched or 'gpipe'!r}); set virtual_stages=1")
+        n_chunks = pp * (vstages if sched == "interleaved" else 1)
+        n_nodes = len(pcg.compute_nodes())
+        if n_chunks > n_nodes:
+            raise PreflightError(
+                f"schedule {sched or 'gpipe'!r} needs pp*v = {pp}*"
+                f"{vstages if sched == 'interleaved' else 1} = "
+                f"{n_chunks} stage chunks but the graph has only "
+                f"{n_nodes} compute nodes; lower virtual_stages (v) or "
+                "the pipeline depth pp")
 
     # per-node PartitionSpec dataflow (axis exists, sharded dims divide):
     # routed through the ShardLint FF006 checker (ISSUE 7 — one

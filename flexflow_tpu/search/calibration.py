@@ -234,8 +234,13 @@ def rerank_candidates(model, sim) -> bool:
         try:
             if c.pipeline:
                 pp, pdp, n_micro = tuple(c.pipeline)
-                t, mem = simulate_pipeline(sim, model.pcg, pp, pdp,
-                                           n_micro, remat=c.remat)
+                # the candidate's SCHEDULE is part of its identity
+                # (ISSUE 10): re-price the same task graph + in-flight
+                # memory the original ranking used, not gpipe's
+                t, mem = simulate_pipeline(
+                    sim, model.pcg, pp, pdp, n_micro, remat=c.remat,
+                    schedule=(c.schedule or "gpipe"),
+                    v=int(getattr(c, "virtual_stages", 1) or 1))
             else:
                 dp, tp = tuple(c.mesh_shape)
                 if batch % max(dp, 1):

@@ -225,6 +225,10 @@ class Simulator:
                  dtype_label: Optional[str] = None):
         self.machine = machine
         self.overlap = overlap_backward_update
+        # per-remat-block psum overlap pricing (--collective-overlap on):
+        # set by unity_search; distinct from the legacy coarse `overlap`
+        # knob — see simulate()'s two hiding models
+        self.block_overlap = False
         self._measure_cache: Dict[Tuple, float] = {}
         # ---- delta-cost engine (reference: simulator.cc's cached task
         # costs making delta re-simulation tractable). Bounded LRUs keyed by
@@ -749,7 +753,23 @@ class Simulator:
             resident_act += sum(
                 self.act_bytes(pcg.nodes[g], el_cache[g])
                 for g in boundary if g in full_guids and g in el_cache)
-        if self.overlap:
+        if getattr(self, "block_overlap", False):
+            # collective-compute overlap (--collective-overlap on):
+            # gradient psums issue per remat block as each block's
+            # backward completes (executor._blockwise_value_and_grad), so
+            # all but the LAST block's sync hides behind the remaining
+            # backward compute; the tail block's reduction is always
+            # exposed (nothing left to hide behind — with ONE block the
+            # executor genuinely hides nothing). K is the executor's own
+            # block count — the same segmentation, two consumers
+            # (execution.remat.remat_segments).
+            k = max(len(self._remat_segments_for(pcg)), 1)
+            total_sync = max(total_sync - total_bwd * (k - 1) / k,
+                             total_sync / k)
+        elif self.overlap:
+            # legacy --overlap (overlap backward with optimizer update):
+            # the coarse pre-ISSUE 10 hiding model, kept verbatim so
+            # existing --overlap users' rankings don't shift
             total_sync = max(0.0, total_sync - 0.7 * total_bwd)
         return (total_compute + total_comm + total_sync + total_update,
                 resident_w + resident_act + transient)

@@ -116,6 +116,11 @@ class RankedCandidate:
     sim_memory: int = 0
     feasible: bool = True
     pipeline: Optional[Tuple[int, int, int]] = None
+    # pipeline schedule of the candidate (ISSUE 10): gpipe | 1f1b |
+    # interleaved ("" for SPMD candidates), with the interleaved virtual
+    # chunk count — distinct schedules of one grid are distinct candidates
+    schedule: str = ""
+    virtual_stages: int = 1
     strategy_json: Optional[str] = None
 
     def describe(self) -> str:
@@ -124,6 +129,11 @@ class RankedCandidate:
         bits = [f"mesh={tuple(self.mesh_shape)}"]
         if self.pipeline:
             bits.append(f"pipeline={tuple(self.pipeline)}")
+            from ..parallel.pipeline import describe_schedule
+
+            sched = describe_schedule(self.schedule, self.virtual_stages)
+            if sched:
+                bits.append(f"schedule={sched}")
         if self.remat and self.remat != "none":
             bits.append(f"remat={self.remat}")
         if tuple(self.dcn) != (1, 1):
@@ -580,65 +590,83 @@ def pipeline_microbatch_safe(pcg: PCG, batch: int) -> bool:
 
 
 def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
-                      n_micro: int, remat: str = "full"
+                      n_micro: int, remat: str = "full",
+                      schedule: str = "gpipe", v: int = 1
                       ) -> Tuple[float, int]:
-    """(step time, per-chip memory) for a GPipe (pp, dp) grid with
+    """(step time, per-chip memory) for a pipelined (pp, dp) grid with
     ``n_micro`` microbatches, at stage-remat level ``remat`` (default
-    ``full`` — the classic GPipe recompute-the-stage recipe, and what
-    PipelineTrainer ran unconditionally before remat became leveled).
+    ``full`` — the classic GPipe recompute-the-stage recipe) under
+    ``schedule`` in {gpipe, 1f1b, interleaved} (``v`` virtual chunks per
+    device for interleaved — docs/pipeline.md).
 
-    The GPipe schedule is built as a TASK GRAPH and run through the SAME
+    The schedule is built as a TASK GRAPH and run through the SAME
     event-driven native engine that costs SPMD candidates (reference prices
     every strategy through simulate_runtime, simulator.cc:815 — one cost
-    engine, unbiased decision boundary): per-(microbatch, stage) forward and
-    remat+backward tasks on per-stage compute devices, boundary activation/
-    gradient hops on per-link devices, weight-grad allreduce + optimizer
-    update after each stage's flush. The bubble emerges from the schedule
-    instead of a closed form. Falls back to the additive closed form only
-    when the native core is unavailable.
+    engine, unbiased decision boundary): per-(microbatch, chunk) forward
+    and remat+backward tasks on per-device compute streams, boundary
+    activation/gradient hops on per-link devices, weight-grad allreduce +
+    optimizer update after each chunk's flush. 1f1b/interleaved graphs
+    additionally chain each device's tasks in the order
+    ``parallel.pipeline.pipeline_schedule`` emits — the SAME generator the
+    trainer's host loop dispatches from, so the simulator prices exactly
+    the execution order the trainer runs; the bubble (and interleaved's
+    ~v-fold fill shrink) emerges from the schedule, no closed forms.
+    Falls back to the additive closed form only when the native core is
+    unavailable.
 
-    Multi-host layout: stages are laid out contiguously over the machine's
-    chips, so stage s's dp group occupies chips [s*dp, (s+1)*dp) — each
-    stage's host span (DCN factor of its gradient sync) and each boundary's
-    medium (ICI within a host, DCN across) come from those cumulative chip
-    positions, covering pp < hosts and hosts∤pp alike.
+    Multi-host layout: device rows are laid out contiguously over the
+    machine's chips, so row d's dp group occupies chips [d*dp, (d+1)*dp) —
+    each row's host span (DCN factor of its gradient sync) and each
+    boundary's medium (ICI within a host, DCN across) come from those
+    cumulative chip positions, covering pp < hosts and hosts∤pp alike.
 
-    Memory = the heaviest stage's weights + grads (replicated over its dp
-    group) + one microbatch's backward-jit peak: the remat level's kept
-    residuals (keep-fraction from ``Simulator.remat_keep_fraction`` — the
-    SAME helper the SPMD memory model uses, one source of truth) plus the
-    recompute working set. Kept residuals never span microbatches here —
-    the trainer's fwd and bwd are separate jits. At ``full`` the kept term
-    is zero — the pre-leveled formula."""
+    Memory = the heaviest device row's weights + grads (replicated over
+    its dp group) + the SCHEDULE's in-flight boundary activations
+    (``pipeline_in_flight`` — n_micro for gpipe's flush, ~pp for 1f1b;
+    the trainer retains exactly this set, releasing a microbatch's stage
+    inputs/outputs as its backward completes) + the full-batch model
+    inputs staged on their feeding rows (the trainer device_puts them
+    once, microbatch-stacked) + one microbatch's backward-jit peak: the
+    remat level's kept residuals (keep-fraction from
+    ``Simulator.remat_keep_fraction`` — the SAME helper the SPMD memory
+    model uses) plus the recompute working set. Kept residuals never span
+    microbatches here — the trainer's fwd and bwd are separate jits."""
     from ..ffconst import size_of_datatype
-    from ..parallel.pipeline import build_stage_specs, split_stages
+    from ..parallel.pipeline import (build_stage_specs, pipeline_in_flight,
+                                     split_stages)
 
-    stages = split_stages(pcg, pp)
+    if schedule != "interleaved":
+        v = 1
+    n_chunks = pp * v
+    stages = split_stages(pcg, n_chunks)
     machine = sim.machine
     hosts = machine.num_hosts
     cph = machine.chips_per_host
 
-    def first_host(s: int) -> int:
-        return (s * dp) // cph
+    def dev_of(c: int) -> int:
+        return c % pp
 
-    def stage_host_span(s: int) -> int:
-        return ((s + 1) * dp - 1) // cph - first_host(s) + 1
+    def first_host(d: int) -> int:
+        return (d * dp) // cph
 
-    # per-stage op costs, each priced at that stage's own host span; the
-    # remat level rides the OpSharding so op_cost's backward includes the
-    # level's recompute (full: one extra forward per op — exactly what
+    def row_host_span(d: int) -> int:
+        return ((d + 1) * dp - 1) // cph - first_host(d) + 1
+
+    # per-chunk op costs, each priced at its device row's own host span;
+    # the remat level rides the OpSharding so op_cost's backward includes
+    # the level's recompute (full: one extra forward per op — exactly what
     # `stage_bwd += fwd + bwd` hand-rolled before remat was leveled)
     saved_topo = (sim.dp_dcn, sim.tp_dcn)
-    stage_fwd = [0.0] * pp
-    stage_bwd = [0.0] * pp  # includes the level's forward recompute
-    stage_sync = [0.0] * pp
-    stage_upd = [0.0] * pp
-    stage_w = [0] * pp
-    stage_act = [0] * pp
-    stage_keep = [0] * pp  # activations the remat level keeps resident
+    stage_fwd = [0.0] * n_chunks
+    stage_bwd = [0.0] * n_chunks  # includes the level's forward recompute
+    stage_sync = [0.0] * n_chunks
+    stage_upd = [0.0] * n_chunks
+    stage_w = [0] * n_chunks
+    stage_act = [0] * n_chunks
+    stage_keep = [0] * n_chunks  # activations the remat level keeps resident
     try:
-        for s in range(pp):
-            span = stage_host_span(s) if hosts > 1 else 1
+        for s in range(n_chunks):
+            span = row_host_span(dev_of(s)) if hosts > 1 else 1
             sim.set_axis_topology(
                 dp_dcn=span if (span > 1 and dp % span == 0) else 1)
             for g in stages[s]:
@@ -665,13 +693,18 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
         sim.set_axis_topology(*saved_topo)
 
     # per-microbatch boundary hop time (the SAME boundary set the trainer
-    # transfers — build_stage_specs exposes every cross-stage tensor,
-    # residual skips included)
+    # transfers — build_stage_specs exposes every cross-chunk tensor,
+    # residual skips included). Interleaved pays a hop at EVERY chunk cut
+    # (adjacent chunks live on different device rows) — the schedule's
+    # known communication tax, priced here.
     specs = build_stage_specs(pcg, stages)
-    bnd_micro = [0.0] * max(pp - 1, 0)
-    for s in range(pp - 1):
+    bnd_micro = [0.0] * max(n_chunks - 1, 0)
+    bnd_bytes_micro = [0] * max(n_chunks - 1, 0)  # per-microbatch bytes
+    for s in range(n_chunks - 1):
+        same_dev = dev_of(s) == dev_of(s + 1)
         medium = "dcn" if (hosts > 1 and
-                           first_host(s) != first_host(s + 1)) else "ici"
+                           first_host(dev_of(s)) !=
+                           first_host(dev_of(s + 1))) else "ici"
         for g, i in specs[s].outputs:
             node = pcg.nodes[g]
             # at least 1 byte: integer flooring to 0 would price the hop at
@@ -679,29 +712,70 @@ def simulate_pipeline(sim: Simulator, pcg: PCG, pp: int, dp: int,
             nbytes = max(int(np.prod(node.out_shapes[i])) *
                          size_of_datatype(node.op.data_type)
                          // (max(dp, 1) * max(n_micro, 1)), 1)
-            bnd_micro[s] += machine.p2p_time(nbytes, medium)
+            bnd_bytes_micro[s] += nbytes
+            if not same_dev:
+                bnd_micro[s] += machine.p2p_time(nbytes, medium)
 
     m_f = [t / max(n_micro, 1) for t in stage_fwd]
     m_b = [t / max(n_micro, 1) for t in stage_bwd]
-    # the trainer's fwd and bwd are separate jits, so NOTHING kept by the
-    # policy survives across microbatches — the level only changes the
-    # in-jit peak of ONE microbatch's backward: the policy's kept
-    # residuals (keep/n_micro) on top of the recompute working set
-    # (act/n_micro). At `full` (keep == 0) this reduces to the
-    # pre-leveled formula.
-    mem = max(2 * w + (keep + act) // max(n_micro, 1)
-              for w, act, keep in zip(stage_w, stage_act, stage_keep))
+
+    # ---- memory: per device row, weights + grads, the schedule's
+    # in-flight boundary activations, the staged full-batch inputs, and
+    # one microbatch's backward-jit peak (kept residuals + recompute
+    # working set — nothing kept by the policy survives across
+    # microbatches: the trainer's fwd and bwd are separate jits)
+    in_flight = pipeline_in_flight(schedule, pp, n_micro, v)
+    row_w = [0] * pp
+    row_peak = [0] * pp   # one-microbatch backward peak (keep + act)
+    row_bnd = [0] * pp    # per-microbatch boundary residency (in + out)
+    row_inputs = [0] * pp  # full-batch model inputs staged on the row
+    input_bytes = {n.guid: max(int(np.prod(n.out_shapes[0])) *
+                               size_of_datatype(n.op.data_type)
+                               // max(dp, 1), 1)
+                   for n in pcg.input_nodes()}
+    for s in range(n_chunks):
+        d = dev_of(s)
+        row_w[d] += stage_w[s]
+        # a row's chunks run their backwards ONE at a time (same devices),
+        # so only the widest chunk's backward-jit peak is live — max, not
+        # sum (summing would overcharge interleaved rows by ~v x)
+        row_peak[d] = max(row_peak[d],
+                          (stage_keep[s] + stage_act[s]) //
+                          max(n_micro, 1))
+        # boundary tensors this chunk holds per in-flight microbatch: its
+        # incoming cut (stage inputs) + its outgoing cut (stage outputs,
+        # kept for the backward's cotangent accumulation)
+        if s > 0:
+            row_bnd[d] += bnd_bytes_micro[s - 1]
+        if s < n_chunks - 1:
+            row_bnd[d] += bnd_bytes_micro[s]
+        for feed in specs[s].feeds:
+            if feed[0] == "model":
+                row_inputs[d] += input_bytes.get(feed[1], 0)
+    mem = max(2 * w + in_flight * bnd + peak + inp
+              for w, bnd, peak, inp in
+              zip(row_w, row_bnd, row_peak, row_inputs))
 
     try:
-        t = _pipeline_taskgraph_makespan(pp, n_micro, m_f, m_b, bnd_micro,
-                                         stage_sync, stage_upd)
+        # ONE builder for every schedule: per-device order chains from the
+        # shared generator, so gpipe/1f1b/interleaved makespans are
+        # apples-to-apples models of the trainer's real dispatch order
+        # (an unchained gpipe graph lets the engine reorder a device's
+        # tasks work-conservingly — slightly optimistic, and unfair to
+        # the chained schedules under uneven stage costs)
+        t = _pipeline_taskgraph_makespan_sched(
+            pp, v, n_micro, m_f, m_b, bnd_micro, stage_sync,
+            stage_upd, schedule)
     except (ImportError, OSError) as e:
         _warn_once("native-pipe-sim", "native core unavailable for the "
                    "pipeline candidate (%s); using the additive bound", e)
         micro = [f + b for f, b in zip(m_f, m_b)]
-        t = (sum(micro) + (n_micro - 1) * max(micro)
-             + 2 * n_micro * sum(bnd_micro)
-             + max(s + u for s, u in zip(stage_sync, stage_upd)))
+        # diagonal fill through every chunk + steady state on the busiest
+        # device row (row d owns chunks d, d+pp, ... under interleaving)
+        t = (sum(micro) + (n_micro - 1) * max(
+            sum(micro[d::pp]) for d in range(pp))
+            + 2 * n_micro * sum(bnd_micro)
+            + max(s + u for s, u in zip(stage_sync, stage_upd)))
     return t, mem
 
 
@@ -781,6 +855,99 @@ def _pipeline_taskgraph_makespan(pp: int, n_micro: int,
                 edge(tail, up)
     return simulate_taskgraph(
         np.asarray(costs), np.asarray(devs), 3 * pp - 1,
+        np.asarray(esrc, dtype=np.int32),
+        np.asarray(edst, dtype=np.int32))
+
+
+def _pipeline_taskgraph_makespan_sched(pp: int, v: int, n_micro: int,
+                                       m_f: List[float], m_b: List[float],
+                                       bnd_micro: List[float],
+                                       stage_sync: List[float],
+                                       stage_upd: List[float],
+                                       schedule: str) -> float:
+    """Event-driven makespan of a pipeline schedule (gpipe, 1f1b or
+    interleaved). Devices: [0, pp) device-row compute streams,
+    [pp, pp + n_chunks - 1) boundary links, then pp per-row collective
+    streams. The per-row execution order comes from
+    ``parallel.pipeline.pipeline_schedule`` — the SAME generator the
+    trainer dispatches from — encoded as chain edges between a row's
+    consecutive tasks, so the makespan is the makespan of exactly the
+    order the trainer runs (not an idealized work-conserving bound), and
+    the three schedules are compared apples-to-apples."""
+    from ..native import simulate_taskgraph
+    from ..parallel.pipeline import pipeline_schedule
+
+    n_chunks = pp * (v if schedule == "interleaved" else 1)
+    last = n_chunks - 1
+    costs: List[float] = []
+    devs: List[int] = []
+    esrc: List[int] = []
+    edst: List[int] = []
+
+    def add(cost: float, dev: int) -> int:
+        costs.append(cost)
+        devs.append(dev)
+        return len(costs) - 1
+
+    def edge(a: int, b: int) -> None:
+        esrc.append(a)
+        edst.append(b)
+
+    # boundary links are FULL-DUPLEX (ICI): the activation hop forward and
+    # the gradient hop back ride separate directional streams — sharing
+    # one stream would falsely serialize 1f1b's steady state, where the
+    # two directions of a cut are busy simultaneously (gpipe's fill and
+    # drain phases never overlap, so it would never pay that artifact)
+    n_links = max(n_chunks - 1, 0)
+    link_f = lambda c: pp + c                 # noqa: E731
+    link_b = lambda c: pp + n_links + c       # noqa: E731
+    coll = lambda d: pp + 2 * n_links + d     # noqa: E731
+
+    fid: Dict[Tuple[int, int], int] = {}
+    bid: Dict[Tuple[int, int], int] = {}
+    prev_on_row: Dict[int, int] = {}
+    for phase, m, c in pipeline_schedule(schedule, pp, n_micro, v):
+        d = c % pp
+        tid = add(m_f[c] if phase == "F" else m_b[c], d)
+        (fid if phase == "F" else bid)[(m, c)] = tid
+        if d in prev_on_row:  # the row executes in schedule order
+            edge(prev_on_row[d], tid)
+        prev_on_row[d] = tid
+    bwd_ids: List[List[int]] = [[] for _ in range(n_chunks)]
+    for m in range(n_micro):
+        for c in range(n_chunks):
+            f = fid[(m, c)]
+            b = bid[(m, c)]
+            edge(f, b)  # remat consumes the stored chunk input
+            if c < last:
+                # activation hop to the next chunk's forward
+                fc = add(bnd_micro[c], link_f(c))
+                edge(f, fc)
+                edge(fc, fid[(m, c + 1)])
+                # gradient hop back from the next chunk's backward
+                bc = add(bnd_micro[c], link_b(c))
+                edge(bid[(m, c + 1)], bc)
+                edge(bc, b)
+            bwd_ids[c].append(b)
+    for c in range(n_chunks):
+        tail = bwd_ids[c][-1]
+        if stage_sync[c] > 0:
+            # grad allreduce waits for the chunk's ENTIRE backward flush —
+            # every microbatch contributes to the weight grads
+            sy = add(stage_sync[c], coll(c % pp))
+            for b in bwd_ids[c]:
+                edge(b, sy)
+            tail = sy
+        if stage_upd[c] > 0:
+            up = add(stage_upd[c], c % pp)
+            if tail == bwd_ids[c][-1]:  # no sync: update waits on all bwds
+                for b in bwd_ids[c]:
+                    edge(b, up)
+            else:
+                edge(tail, up)
+    return simulate_taskgraph(
+        np.asarray(costs), np.asarray(devs),
+        2 * pp + 2 * n_links,
         np.asarray(esrc, dtype=np.int32),
         np.asarray(edst, dtype=np.int32))
 
@@ -1243,18 +1410,29 @@ def _build_ranked(best: SearchResult,
         consider((mesh, dcn, remat, None), feas, r.sim_time, r.sim_memory,
                  r, None)
     for c in pipe_cands:
+        # distinct schedules of one (grid, remat) are distinct fallback
+        # candidates: a 1f1b plan that fails can degrade to its gpipe twin
         consider((tuple(c.mesh_shape), tuple(c.dcn), c.remat,
-                  tuple(c.pipeline)), c.feasible, c.sim_time, c.sim_memory,
-                 None, c)
+                  tuple(c.pipeline), c.schedule, c.virtual_stages),
+                 c.feasible, c.sim_time, c.sim_memory, None, c)
 
     win_pipe = (tuple(best.strategy.pipeline)
                 if getattr(best.strategy, "pipeline", None) else None)
-    win_key = (tuple(best.mesh_shape), tuple(best.dcn), best.remat, win_pipe)
+    win_sched = (getattr(best.strategy, "schedule", "") or "gpipe") \
+        if win_pipe else ""
+    win_v = int(getattr(best.strategy, "virtual_stages", 1) or 1) \
+        if win_pipe else 1
+    if win_pipe:
+        win_key: Tuple = (tuple(best.mesh_shape), tuple(best.dcn),
+                          best.remat, win_pipe, win_sched, win_v)
+    else:
+        win_key = (tuple(best.mesh_shape), tuple(best.dcn), best.remat,
+                   None)
     ranked = [RankedCandidate(
         mesh_shape=tuple(best.mesh_shape), dcn=tuple(best.dcn),
         remat=best.remat, sim_time=best.sim_time, sim_memory=best.sim_memory,
         feasible=bool(mem_budget is None or best.sim_memory <= mem_budget),
-        pipeline=win_pipe)]
+        pipeline=win_pipe, schedule=win_sched, virtual_stages=win_v)]
     others = sorted(((key, v) for key, v in entries.items()
                      if key != win_key),
                     key=lambda kv: (not kv[1][0], kv[1][1], repr(kv[0])))
@@ -1302,10 +1480,16 @@ def unity_search(pcg: PCG, config, n_dev: int,
     if sim is None:
         from .calibration import dtype_label
 
-        sim = Simulator(machine, config.search_overlap_backward_update,
+        # --collective-overlap on prices the per-block hidden sync
+        # fraction (simulator.simulate's block model); the legacy
+        # --overlap knob keeps its own coarse hiding model untouched
+        sim = Simulator(machine,
+                        bool(config.search_overlap_backward_update),
                         calibration_dir=getattr(config, "calibration_dir",
                                                 "") or None,
                         dtype_label=dtype_label(config))
+        sim.block_overlap = (getattr(config, "collective_overlap", "off")
+                             or "off") == "on"
     # the simulator must price full-remat blocks at the SAME size the
     # Executor will cut them (execution/remat.py's one-segmentation rule)
     sim.remat_segment_size = int(
@@ -1545,6 +1729,13 @@ def unity_search(pcg: PCG, config, n_dev: int,
             pipe_levels = ((forced_remat,) if forced_remat
                            else remat_levels
                            if config.perform_memory_search else ("full",))
+            # the pipeline SCHEDULE is a searched axis too (ISSUE 10):
+            # gpipe/1f1b sweep always; interleaved (v=2 virtual chunks per
+            # device) when the graph has enough nodes to cut pp*v chunks.
+            # --schedule forces one schedule, like --remat forces a level.
+            forced_sched = (getattr(config, "schedule", "") or "").strip()
+            forced_v = int(getattr(config, "pipeline_virtual_stages", 0)
+                           or 0)
             for pp in (2, 4, 8):
                 if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
                     continue
@@ -1554,45 +1745,91 @@ def unity_search(pcg: PCG, config, n_dev: int,
                               (batch // m) % max(pdp, 1) == 0), None)
                 if micro is None:
                     continue
+                if forced_sched:
+                    # v only applies to interleaved: a stray
+                    # --virtual-stages with a forced 1f1b/gpipe must not
+                    # leak into the winner (preflight would reject it)
+                    v = (forced_v or 2) \
+                        if forced_sched == "interleaved" else 1
+                    pipe_scheds = [(forced_sched, v)] if (
+                        pp * v <= n_nodes and
+                        (forced_sched != "interleaved"
+                         or micro % pp == 0)) else []
+                else:
+                    pipe_scheds = [("gpipe", 1), ("1f1b", 1)]
+                    # interleaved needs pp*v chunks to cut and microbatch
+                    # rounds of pp (preflight names the same constraints)
+                    if 2 * pp <= n_nodes and micro % pp == 0:
+                        pipe_scheds.append(("interleaved", 2))
                 for lv in pipe_levels:
-                    t_pipe, m_pipe = simulate_pipeline(sim, base_pcg, pp,
-                                                       pdp, micro, remat=lv)
-                    _log.info("pipeline pp=%d dp=%d m=%d remat=%s -> "
-                              "%.3f ms, %.1f MiB", pp, pdp, micro, lv,
-                              t_pipe * 1e3, m_pipe / 2 ** 20)
-                    # accepted must mirror the ACTUAL decision below,
-                    # memory budget included, or replaying the log
-                    # reconstructs a different search than the one that ran
-                    pipe_ok = t_pipe < best.sim_time and (
-                        not config.perform_memory_search or
-                        m_pipe <= hbm_budget)
-                    # mesh recorded as the winner convention (n_dev, 1) so
-                    # an accepted grid's entry dedupes against its own
-                    # SearchResult in the ranking
-                    pipe_cands.append(RankedCandidate(
-                        mesh_shape=(n_dev, 1), remat=lv, sim_time=t_pipe,
-                        sim_memory=m_pipe,
-                        feasible=bool(not config.perform_memory_search
-                                      or m_pipe <= hbm_budget),
-                        pipeline=(pp, pdp, micro)))
-                    slog.log(event="pipeline_candidate", pp=pp, dp=pdp,
-                             n_micro=micro, remat=lv,
-                             cost_ms=round(t_pipe * 1e3, 4),
-                             mem_mib=round(m_pipe / 2 ** 20, 1),
-                             accepted=bool(pipe_ok),
-                             best_ms=round((t_pipe if pipe_ok
-                                            else best.sim_time) * 1e3, 4))
-                    if pipe_ok:
-                        from ..parallel.strategy import \
-                            data_parallel_strategy
+                    for sched, sv in pipe_scheds:
+                        t_pipe, m_pipe = simulate_pipeline(
+                            sim, base_pcg, pp, pdp, micro, remat=lv,
+                            schedule=sched, v=sv)
+                        _log.info(
+                            "pipeline pp=%d dp=%d m=%d remat=%s "
+                            "schedule=%s v=%d -> %.3f ms, %.1f MiB",
+                            pp, pdp, micro, lv, sched, sv,
+                            t_pipe * 1e3, m_pipe / 2 ** 20)
+                        # accepted must mirror the ACTUAL decision below,
+                        # memory budget included, or replaying the log
+                        # reconstructs a different search than the one
+                        # that ran. Ties on time (1f1b's makespan equals
+                        # gpipe's under uniform stages — the bubble
+                        # fraction is the same (S-1)/(M+S-1); memory is
+                        # its win) break toward LOWER memory; an exact
+                        # tie on both (the swept n_micro == pp regime,
+                        # where in-flight counts coincide) still prefers
+                        # the non-gpipe schedule — 1f1b DOMINATES gpipe
+                        # (never worse, strictly less in-flight memory
+                        # once the fit loop re-derives n_micro = 2*pp
+                        # for a real batch), so the tie is not a toss-up.
+                        feas = (not config.perform_memory_search
+                                or m_pipe <= hbm_budget)
+                        is_pipe_best = bool(
+                            getattr(best.strategy, "pipeline", None))
+                        best_sched = (getattr(best.strategy, "schedule",
+                                              "") or "gpipe")
+                        pipe_ok = feas and (
+                            t_pipe < best.sim_time * (1 - 1e-9)
+                            or (is_pipe_best
+                                and t_pipe <= best.sim_time * (1 + 1e-9)
+                                and (m_pipe < best.sim_memory
+                                     or (m_pipe <= best.sim_memory
+                                         and best_sched == "gpipe"
+                                         and sched != "gpipe"))))
+                        # mesh recorded as the winner convention
+                        # (n_dev, 1) so an accepted grid's entry dedupes
+                        # against its own SearchResult in the ranking
+                        pipe_cands.append(RankedCandidate(
+                            mesh_shape=(n_dev, 1), remat=lv,
+                            sim_time=t_pipe, sim_memory=m_pipe,
+                            feasible=bool(feas),
+                            pipeline=(pp, pdp, micro),
+                            schedule=sched, virtual_stages=sv))
+                        slog.log(event="pipeline_candidate", pp=pp,
+                                 dp=pdp, n_micro=micro, remat=lv,
+                                 schedule=sched, virtual_stages=sv,
+                                 cost_ms=round(t_pipe * 1e3, 4),
+                                 mem_mib=round(m_pipe / 2 ** 20, 1),
+                                 accepted=bool(pipe_ok),
+                                 best_ms=round((t_pipe if pipe_ok
+                                                else best.sim_time)
+                                               * 1e3, 4))
+                        if pipe_ok:
+                            from ..parallel.strategy import \
+                                data_parallel_strategy
 
-                        strat = data_parallel_strategy(pcg, n_dev)
-                        strat.pipeline = (pp, pdp, micro)
-                        strat.remat = lv
-                        best = SearchResult(
-                            strategy=strat, assignment={}, sim_time=t_pipe,
-                            sim_memory=m_pipe, mesh_shape=(n_dev, 1),
-                            pcg=None, states=None, remat=lv)
+                            strat = data_parallel_strategy(pcg, n_dev)
+                            strat.pipeline = (pp, pdp, micro)
+                            strat.schedule = sched
+                            strat.virtual_stages = sv
+                            strat.remat = lv
+                            best = SearchResult(
+                                strategy=strat, assignment={},
+                                sim_time=t_pipe, sim_memory=m_pipe,
+                                mesh_shape=(n_dev, 1), pcg=None,
+                                states=None, remat=lv)
 
     # delta-cost engine telemetry: wall time, throughput and cache counters
     # land on the SearchResult (bench.py's search_wall_s metric) and in the
@@ -1624,6 +1861,8 @@ def unity_search(pcg: PCG, config, n_dev: int,
             {"rank": i, "mesh": list(c.mesh_shape), "dcn": list(c.dcn),
              "remat": c.remat,
              "pipeline": list(c.pipeline) if c.pipeline else None,
+             "schedule": c.schedule or None,
+             "virtual_stages": c.virtual_stages,
              "cost_ms": round(c.sim_time * 1e3, 4),
              "mem_mib": round(c.sim_memory / 2 ** 20, 1),
              "feasible": bool(c.feasible)}
@@ -1634,6 +1873,9 @@ def unity_search(pcg: PCG, config, n_dev: int,
                  pipeline=(list(best.strategy.pipeline)
                            if getattr(best.strategy, "pipeline", None)
                            else None),
+                 schedule=(getattr(best.strategy, "schedule", "") or None),
+                 virtual_stages=int(
+                     getattr(best.strategy, "virtual_stages", 1) or 1),
                  search_wall_s=round(search_wall_s, 4),
                  candidates=candidates,
                  candidates_per_s=round(candidates / search_wall_s, 2)

@@ -280,6 +280,15 @@ def summarize_jsonl(records, top: int) -> None:
                 if r.get("pipeline"):
                     pp, pdp, m = r["pipeline"]
                     bits.append(f"pipeline pp={pp} dp={pdp} n_micro={m}")
+                    # the searched schedule rides next to the grid
+                    # (ISSUE 10): gpipe | 1f1b | interleaved(v=...)
+                    from flexflow_tpu.parallel.pipeline import \
+                        describe_schedule
+
+                    sched = describe_schedule(
+                        r.get("schedule") or "",
+                        int(r.get("virtual_stages", 1) or 1))
+                    bits.append(f"schedule={sched or 'gpipe'}")
                 bits.append(f"remat={r.get('remat', 'none')}")
                 print("searched plan: " + "  ".join(bits))
             if r.get("search_wall_s") is not None:

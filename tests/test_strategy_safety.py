@@ -102,9 +102,12 @@ def test_search_result_ranked_topk_deterministic():
     top = r1.ranked[0]
     assert tuple(top.mesh_shape) == tuple(r1.mesh_shape)
     assert top.remat == r1.remat
-    # runners-up are distinct plans; SPMD ones are re-mappable by name
+    # runners-up are distinct plans; SPMD ones are re-mappable by name.
+    # Distinct pipeline SCHEDULES of one grid are distinct candidates
+    # (ISSUE 10): the schedule joins the plan key.
     keys = [(tuple(c.mesh_shape), tuple(c.dcn), c.remat,
-             tuple(c.pipeline) if c.pipeline else None)
+             tuple(c.pipeline) if c.pipeline else None,
+             c.schedule, c.virtual_stages)
             for c in r1.ranked]
     assert len(set(keys)) == len(keys)
     for c in r1.ranked[1:]:
