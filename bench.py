@@ -77,6 +77,58 @@ def tpu_responsive_with_retry(max_retries: int = 2, backoff_s: float = 30.0
 
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_last_good.json")
+
+
+def _head_commit():
+    """(sha, commit unix time) of the newest source commit, or
+    (None, None) when git is unavailable — the staleness guard then
+    cannot judge and keeps the legacy echo behavior."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "log", "-1", "--format=%H %ct"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0 and r.stdout.strip():
+            sha, ct = r.stdout.split()
+            return sha, int(ct)
+    except Exception:
+        pass
+    return None, None
+
+
+def _stale_last_good(last_good: dict, head_sha, head_time):
+    """Bench staleness guard (ISSUE 11 satellite, ROADMAP standing item):
+    decide whether the tunnel-outage fallback may echo this
+    BENCH_last_good.json. The fallback exists so a transient outage does
+    not erase measured numbers — but echoing a record from an OLDER
+    source commit forever would mask regressions indefinitely. Returns
+    None when the record is fresh (same commit, or a commit no older
+    than HEAD, or git unavailable), else a dict explaining the
+    staleness (``stale_fallback: true`` + age) that replaces the echo.
+    Pure function of its inputs — pinned by tests/test_housekeeping_r12.
+    """
+    if head_sha is None:
+        return None  # no git to judge against: legacy behavior
+    rec_sha = last_good.get("source_commit")
+    rec_time = last_good.get("source_commit_time")
+    if rec_sha == head_sha:
+        return None
+    if rec_sha is None or rec_time is None:
+        return {"stale_fallback": True,
+                "stale_reason": ("last-good record predates the "
+                                 "staleness guard (no source_commit); "
+                                 "re-run the bench on-chip to refresh")}
+    if int(rec_time) < int(head_time):
+        return {"stale_fallback": True,
+                "stale_reason": ("last-good was measured at source "
+                                 "commit older than HEAD; a regression "
+                                 "since then would be invisible in the "
+                                 "echoed numbers"),
+                "last_good_commit": rec_sha,
+                "stale_age_s": int(head_time) - int(rec_time)}
+    return None
 # machine-readable phase breakdown of the bench itself (obs subsystem):
 # Chrome-trace JSON summarizable via scripts/trace_summary.py, so rounds can
 # diff where bench time went between PRs
@@ -113,17 +165,29 @@ def main():
                "retries_attempted": retries_attempted}
         # echo the most recent SUCCESSFUL on-chip run, clearly labeled —
         # a transient tunnel outage should not erase the round's measured
-        # numbers from the record
+        # numbers from the record. Staleness guard (ISSUE 11 satellite):
+        # a last-good from an OLDER source commit is NOT echoed — the
+        # fallback must not mask regressions indefinitely; an explicit
+        # stale_fallback marker + age replaces the numbers.
         try:
             with open(LAST_GOOD_PATH) as f:
-                out["last_good_onchip_result"] = json.load(f)
+                last_good = json.load(f)
+            stale = _stale_last_good(last_good, *_head_commit())
             out["last_good_mtime"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ",
                 time.gmtime(os.path.getmtime(LAST_GOOD_PATH)))
-            out["note"] = ("TPU tunnel unresponsive at bench time; "
-                           "last_good_onchip_result is the most recent "
-                           "successful on-chip run of this same bench "
-                           "(see last_good_mtime)")
+            if stale is None:
+                out["last_good_onchip_result"] = last_good
+                out["note"] = ("TPU tunnel unresponsive at bench time; "
+                               "last_good_onchip_result is the most "
+                               "recent successful on-chip run of this "
+                               "same bench (see last_good_mtime)")
+            else:
+                out.update(stale)
+                out["note"] = ("TPU tunnel unresponsive at bench time "
+                               "and the cached last-good record is "
+                               "STALE (see stale_reason); its numbers "
+                               "are deliberately not echoed")
         except (OSError, ValueError):
             pass  # missing or truncated cache must not break the fallback
         print(json.dumps(out))
@@ -221,6 +285,11 @@ def main():
         result.update(pipeline_schedules_leg(on_tpu))
     with tracer.span("collective_overlap_leg"):
         result.update(collective_overlap_leg(on_tpu, cfg))
+    # both tiers (ISSUE 11): the multi-replica router under a scripted
+    # replica kill vs the same slots as independent engines — CPU emits a
+    # clearly-labeled smoke trajectory like the PR 10 legs
+    with tracer.span("fleet_leg"):
+        result.update(fleet_leg(on_tpu))
     if not on_tpu:
         with tracer.span("mfu_bf16opt_sim_leg"):
             result.update(mfu_bf16opt_sim_leg())
@@ -242,7 +311,13 @@ def main():
             with tracer.span(name):
                 result.update(leg())
         try:  # cache for the tunnel-outage fallback path (atomic: a killed
-            # run must not truncate the previous good record)
+            # run must not truncate the previous good record). The source
+            # commit stamp feeds the staleness guard — a fallback round
+            # refuses to echo a record older than the newest commit
+            sha, ct = _head_commit()
+            if sha is not None:
+                result["source_commit"] = sha
+                result["source_commit_time"] = ct
             from flexflow_tpu.obs import atomic_write_json
 
             atomic_write_json(LAST_GOOD_PATH, result)
@@ -553,6 +628,93 @@ def serving_leg() -> dict:
                 plan.sim_tokens_per_s / naive[0].sim_tokens_per_s, 3)
     except Exception as e:
         out["serving_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def fleet_leg(on_tpu) -> dict:
+    """Fleet router leg (ISSUE 11, docs/fleet.md): aggregate tokens/s,
+    p99 per-token latency, occupancy and failover-recovery time for a
+    bursty GPT-2 trace through a 2-replica ServingFleet with one
+    scripted mid-run replica kill, against the same slots run as N
+    independent engines (no router, no failover — the baseline the
+    fleet must not tax). On CPU the walls are a smoke trajectory
+    (``fleet_simulated: true``, mirroring the PR 10 simulated-fallback
+    legs); the TPU tier records the real numbers."""
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+    from flexflow_tpu.resilience import FleetChaosPlan
+    from flexflow_tpu.serving import ServingEngine, ServingFleet
+
+    out = {}
+    try:
+        if on_tpu:
+            cfg = GPT2Config(batch_size=8, seq_len=256, hidden=768,
+                             num_heads=12, num_layers=12,
+                             intermediate=3072, vocab_size=50257)
+            n_req, max_new, slots = 24, 32, 4
+        else:
+            cfg = GPT2Config.tiny(batch_size=8)
+            n_req, max_new, slots = 12, 8, 2
+        # prompt + generation must fit the decode ring (tiny's seq 16)
+        p_lo, p_hi = (4, 12) if on_tpu else (3, 7)
+        config = FFConfig()
+        config.batch_size = cfg.batch_size
+        config.max_decode_len = cfg.seq_len
+        ff = FFModel(config)
+        build_gpt2(ff, cfg)
+        ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                size=int(rng.integers(p_lo, p_hi))).tolist()
+                   for _ in range(n_req)]
+        # independent-engines baseline: the same slots as N engines with
+        # no router above them — each serves its half of the trace, and
+        # a replica kill there would take its whole half down
+        t0 = time.perf_counter()
+        indep_tokens = 0
+        for half in (prompts[0::2], prompts[1::2]):
+            eng = ServingEngine(ff, n_slots=slots,
+                                max_decode_len=cfg.seq_len)
+            eng.generate(half, max_new_tokens=max_new)
+            indep_tokens += eng.stats.tokens_generated
+        indep_wall = time.perf_counter() - t0
+        if indep_wall > 0:
+            out["fleet_independent_tokens_per_s"] = round(
+                indep_tokens / indep_wall, 1)
+        # the fleet: same work through the router, one scripted mid-run
+        # replica kill — migration + failover included in the wall
+        fleet = ServingFleet(ff, n_replicas=2, n_slots=slots,
+                             max_decode_len=cfg.seq_len)
+        kill_tick = 6
+        fleet.generate(prompts, max_new_tokens=max_new,
+                       chaos=FleetChaosPlan(
+                           kill_replica_at={kill_tick: 0}))
+        st = fleet.stats
+        out["fleet_tokens_per_s"] = round(st.tokens_per_s(), 1)
+        out["fleet_occupancy"] = round(
+            st.occupancy(fleet.total_slots()), 3)
+        walls = []
+        for rep in fleet.replicas:
+            if rep.loop is not None:
+                walls.extend(rep.loop.stats.token_walls_s)
+        if walls:
+            out["fleet_p99_token_ms"] = round(
+                float(np.percentile(walls, 99) * 1e3), 3)
+        out["fleet_outcomes"] = dict(st.outcomes)
+        out["fleet_migrations"] = st.migrations
+        rec = st.recovery_ticks(kill_tick, frac=0.5)
+        if rec is not None:
+            out["fleet_failover_recovery_ticks"] = rec
+        if indep_tokens and indep_wall > 0:
+            out["fleet_vs_independent"] = round(
+                st.tokens_per_s() / (indep_tokens / indep_wall), 3)
+        if not on_tpu:
+            out["fleet_simulated"] = True
+    except Exception as e:
+        out["fleet_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

@@ -237,6 +237,23 @@ class FFConfig:
     # decode-health sentinel: retries per request after a quarantined
     # (non-finite) decode slot before the request aborts as decode_fault
     decode_retry_budget: int = 1
+    # serving fleet (flexflow_tpu/serving/fleet.py, docs/fleet.md;
+    # ISSUE 11). Replica count of the multi-replica router: N independent
+    # fault domains behind load-aware dispatch with health-checked
+    # failover; 0 = single-engine serving (no fleet layer)
+    fleet_replicas: int = 0
+    # hedged retries: launch a bounded hedge on a second replica once a
+    # request's wait exceeds this percent of its EWMA-predicted service
+    # time (first new committed token wins, loser cancelled); 0 = off
+    hedge_after_pctl: float = 0.0
+    # active health probes: probe-decode every live replica every N fleet
+    # ticks (half-open circuit probes run on their own backoff schedule
+    # regardless); 0 disables the periodic probe
+    health_probe_every: int = 16
+    # circuit breaker: consecutive per-replica failures (decode
+    # quarantines, dispatch timeouts, failed probes) before the
+    # replica's circuit opens and it stops receiving dispatches
+    circuit_open_after: int = 3
 
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
@@ -437,6 +454,14 @@ class FFConfig:
                 self.drain_grace_s = float(_next())
             elif a == "--decode-retry-budget":
                 self.decode_retry_budget = int(_next())
+            elif a == "--fleet-replicas":
+                self.fleet_replicas = int(_next())
+            elif a == "--hedge-after-pctl":
+                self.hedge_after_pctl = float(_next())
+            elif a == "--health-probe-every":
+                self.health_probe_every = int(_next())
+            elif a == "--circuit-open-after":
+                self.circuit_open_after = int(_next())
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -524,6 +549,27 @@ class FFConfig:
                 f"--decode-retry-budget must be >= 0 (got "
                 f"{self.decode_retry_budget}); 0 aborts a poisoned "
                 "request on its first quarantined decode")
+        if "--fleet-replicas" in seen and self.fleet_replicas < 0:
+            raise ValueError(
+                f"--fleet-replicas must be >= 0 (got "
+                f"{self.fleet_replicas}); 0 serves through a single "
+                "engine with no fleet layer")
+        if "--hedge-after-pctl" in seen and self.hedge_after_pctl < 0:
+            raise ValueError(
+                f"--hedge-after-pctl must be >= 0 (got "
+                f"{self.hedge_after_pctl}): it is the percent of the "
+                "EWMA-predicted service time a request may wait before "
+                "it is hedged on a second replica (0 disables hedging)")
+        if "--health-probe-every" in seen and self.health_probe_every < 0:
+            raise ValueError(
+                f"--health-probe-every must be >= 0 (got "
+                f"{self.health_probe_every}); 0 disables the periodic "
+                "probe (half-open circuit probes still run)")
+        if "--circuit-open-after" in seen and self.circuit_open_after < 1:
+            raise ValueError(
+                f"--circuit-open-after must be >= 1 (got "
+                f"{self.circuit_open_after}): the circuit opens after "
+                "this many consecutive per-replica failures")
         if "--virtual-stages" in seen:
             if self.pipeline_virtual_stages < 2:
                 raise ValueError(

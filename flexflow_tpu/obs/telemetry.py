@@ -141,6 +141,24 @@ class StepTelemetry:
         self.serving_quarantines: int = 0
         self.serving_drains: int = 0
         self.serving_replans: int = 0
+        # fleet counters (ISSUE 11): the multi-replica router's run —
+        # fleet-wide outcome ledger, per-replica dispatch split,
+        # migrations/hedges/failovers and the health machinery's
+        # probe/circuit activity — filled by ServingFleet._merge_telemetry
+        self.fleet_replicas: int = 0
+        self.fleet_ticks: int = 0
+        self.fleet_requests: int = 0
+        self.fleet_tokens_generated: int = 0
+        self.fleet_outcomes: Dict[str, int] = {}
+        self.fleet_sheds: int = 0
+        self.fleet_dispatches: List[int] = []
+        self.fleet_migrations: int = 0
+        self.fleet_hedges: int = 0
+        self.fleet_hedge_twin_wins: int = 0
+        self.fleet_probes: int = 0
+        self.fleet_circuit_opens: int = 0
+        self.fleet_failovers: int = 0
+        self.fleet_health_transitions: int = 0
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -276,6 +294,25 @@ class StepTelemetry:
             if self.serving_p99_token_ms is not None:
                 sv["p99_token_ms"] = round(self.serving_p99_token_ms, 3)
             out["serving"] = sv
+        if self.fleet_replicas:
+            total = max(sum(self.fleet_outcomes.values()), 1)
+            fl: Dict[str, Any] = {
+                "replicas": self.fleet_replicas,
+                "ticks": self.fleet_ticks,
+                "requests": self.fleet_requests,
+                "tokens_generated": self.fleet_tokens_generated,
+                "outcomes": dict(self.fleet_outcomes),
+                "shed_rate": round(self.fleet_sheds / total, 4),
+                "dispatches": list(self.fleet_dispatches),
+                "migrations": self.fleet_migrations,
+                "hedges": self.fleet_hedges,
+                "hedge_twin_wins": self.fleet_hedge_twin_wins,
+                "probes": self.fleet_probes,
+                "circuit_opens": self.fleet_circuit_opens,
+                "failovers": self.fleet_failovers,
+                "health_transitions": self.fleet_health_transitions,
+            }
+            out["fleet"] = fl
         if (self.serving_outcomes or self.serving_sheds
                 or self.serving_deadline_misses or self.serving_quarantines
                 or self.serving_drains or self.serving_replans):

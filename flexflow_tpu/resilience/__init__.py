@@ -25,8 +25,9 @@ checkpoint, never the run:
 ``docs/fault_tolerance.md`` and ``docs/strategy_safety.md``.
 """
 from .audit import AuditError, AuditReport, audit_strategy  # noqa: F401
-from .chaos import (ChaosPlan, corrupt_checkpoint,  # noqa: F401
-                    inject_wrong_reshard)
+from .chaos import (ChaosPlan, FleetChaosPlan,  # noqa: F401
+                    corrupt_checkpoint, inject_wrong_reshard,
+                    poison_decode_state)
 from .elastic import elastic_restore  # noqa: F401
 from .fallback import (MemoryBudgetError, StrategyCascade,  # noqa: F401
                        StrategyCompileError, StrategySafetyError)

@@ -237,21 +237,9 @@ class ChaosPlan:
         slot = self.poison_decode_at.get(step)
         if slot is None or (self.once and step in self._decode_poison_done):
             return state, None
-        import jax
-        import jax.numpy as jnp
-
-        def nanify(leaf):
-            if not jnp.issubdtype(leaf.dtype, jnp.floating):
-                return leaf
-            return leaf.at[slot].set(jnp.asarray(float("nan"), leaf.dtype))
-
-        from ..serving.kvcache import DecodeState
-
-        caches = {name: jax.tree.map(nanify, entry)
-                  for name, entry in state.caches.items()}
         self._decode_poison_done.add(step)
         self.poisoned_decode_steps.append(step)
-        return DecodeState(caches=caches, lengths=state.lengths), slot
+        return poison_decode_state(state, slot), slot
 
     def maybe_storm(self, step: int) -> List:
         """Scripted queue storm: the prompt burst to submit through the
@@ -287,6 +275,112 @@ class ChaosPlan:
         self._drop_done.add(step)
         self.devices_dropped.append(step)
         return n
+
+
+def poison_decode_state(state, slot: int):
+    """NaN one slot's KV-cache rows of a serving ``DecodeState`` — the
+    shared injection primitive behind ``ChaosPlan.maybe_poison_decode``
+    (scripted per-step poison) and ``FleetChaosPlan``'s scripted replica
+    degrade (a sustained poison *rate* on one replica, ISSUE 11).
+    Floating leaves only; every other slot stays bitwise-untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.kvcache import DecodeState
+
+    def nanify(leaf):
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        return leaf.at[slot].set(jnp.asarray(float("nan"), leaf.dtype))
+
+    caches = {name: jax.tree.map(nanify, entry)
+              for name, entry in state.caches.items()}
+    return DecodeState(caches=caches, lengths=state.lengths)
+
+
+class FleetChaosPlan(ChaosPlan):
+    """Scripted fleet-level fault schedule (ISSUE 11, serving/fleet.py).
+
+    Extends :class:`ChaosPlan` with replica-granular faults, keyed on the
+    router's FLEET TICK counter (one tick = every live replica advanced
+    one scheduler action) — the fleet analog of the serving extensions'
+    decode-step keys. All once-semantics, all runnable on CPU in tier-1:
+
+    * ``kill_replica_at={tick: replica}`` — the replica dies abruptly
+      mid-decode (DecodeState lost with its mesh); the router migrates
+      its in-flight streams to survivors (re-prefilled from host-side
+      committed tokens) and re-routes its queue.
+    * ``degrade_replica_at={tick: replica}`` — from that tick on, every
+      ``degrade_poison_every``-th decode step on the replica NaNs one
+      live slot's KV rows (a sustained decode-poison rate, as a flaky
+      HBM bank would produce): the quarantine-rate passive signal should
+      open the replica's circuit breaker. Cleared by ``rejoin_at``.
+    * ``partition_at={tick: replica}`` — router↔replica dispatches raise
+      timeouts for ``partition_ticks`` ticks (the replica itself is
+      healthy; the router just cannot reach it).
+    * ``drain_replica_at={tick: replica}`` — scripted ``fleet.drain``
+      (the rolling zero-downtime restart path).
+    * ``rejoin_at={tick: replica}`` — a killed/drained/degraded replica
+      re-enters through half-open probation (probe decode gates it).
+    """
+
+    def __init__(self, kill_replica_at: Optional[dict] = None,
+                 degrade_replica_at: Optional[dict] = None,
+                 partition_at: Optional[dict] = None,
+                 drain_replica_at: Optional[dict] = None,
+                 rejoin_at: Optional[dict] = None,
+                 partition_ticks: int = 8,
+                 degrade_poison_every: int = 1,
+                 **kw):
+        super().__init__(**kw)
+        self.kill_replica_at = {int(k): int(v) for k, v in
+                                (kill_replica_at or {}).items()}
+        self.degrade_replica_at = {int(k): int(v) for k, v in
+                                   (degrade_replica_at or {}).items()}
+        self.partition_at = {int(k): int(v) for k, v in
+                             (partition_at or {}).items()}
+        self.drain_replica_at = {int(k): int(v) for k, v in
+                                 (drain_replica_at or {}).items()}
+        self.rejoin_at = {int(k): int(v) for k, v in
+                          (rejoin_at or {}).items()}
+        self.partition_ticks = int(partition_ticks)
+        self.degrade_poison_every = max(int(degrade_poison_every), 1)
+        self.replicas_killed: List[int] = []
+        self.replicas_degraded: List[int] = []
+        self.replicas_partitioned: List[int] = []
+        self.replicas_drained: List[int] = []
+        self.replicas_rejoined: List[int] = []
+        self._fleet_done: set = set()
+
+    def _fire(self, table: dict, tick: int, kind: str,
+              log: List[int]) -> Optional[int]:
+        replica = table.get(tick)
+        if replica is None or (self.once and (kind, tick) in
+                               self._fleet_done):
+            return None
+        self._fleet_done.add((kind, tick))
+        log.append(replica)
+        return replica
+
+    def maybe_kill_replica(self, tick: int) -> Optional[int]:
+        return self._fire(self.kill_replica_at, tick, "kill",
+                          self.replicas_killed)
+
+    def maybe_degrade_replica(self, tick: int) -> Optional[int]:
+        return self._fire(self.degrade_replica_at, tick, "degrade",
+                          self.replicas_degraded)
+
+    def maybe_partition_replica(self, tick: int) -> Optional[int]:
+        return self._fire(self.partition_at, tick, "partition",
+                          self.replicas_partitioned)
+
+    def maybe_drain_replica(self, tick: int) -> Optional[int]:
+        return self._fire(self.drain_replica_at, tick, "drain",
+                          self.replicas_drained)
+
+    def maybe_rejoin_replica(self, tick: int) -> Optional[int]:
+        return self._fire(self.rejoin_at, tick, "rejoin",
+                          self.replicas_rejoined)
 
 
 class _InjectedReductionOp:

@@ -447,6 +447,22 @@ class ServingEngine:
                    chaos=chaos, resilience=res)
         return [list(r.generated) for r in reqs]
 
+    def start_serve(self, sched: ContinuousBatchScheduler,
+                    temperature: float = 0.0, top_k: int = 0,
+                    seed: int = 0, chaos=None, resilience=None,
+                    publish_telemetry: bool = True) -> "_ServeLoop":
+        """Begin a serve run without driving it to completion: returns
+        the :class:`_ServeLoop` whose ``tick()`` advances exactly one
+        scheduler action (a prefill or one decode step). This is the
+        hook the fleet router (``serving/fleet.py``, ISSUE 11) uses to
+        interleave N replicas' progress in one host loop; standalone
+        ``serve()`` is exactly ``start_serve`` + ``while tick()`` +
+        ``finish()``."""
+        return _ServeLoop(self, sched, temperature=temperature,
+                          top_k=top_k, seed=seed, chaos=chaos,
+                          resilience=resilience,
+                          publish_telemetry=publish_telemetry)
+
     def serve(self, sched: ContinuousBatchScheduler,
               temperature: float = 0.0, top_k: int = 0,
               seed: int = 0, chaos=None, resilience=None) -> ServingStats:
@@ -468,249 +484,60 @@ class ServingEngine:
         the existing ``elastic_replan`` automatically with bounded
         backoff. A plain serve (nothing armed) pays none of the
         per-iteration costs."""
-        import jax
-        import jax.numpy as jnp
-
         from ..resilience.session import ResilienceSession
-        from .resilience import DecodeStateLostError, ServingResilience
 
-        tracer = self._tracer()
-        params = self.model.params
-        sampler = self._sampler(temperature, top_k)
-        stats = self.stats = ServingStats()
-        pending = self._pending_resilience
-        res = resilience or pending or self._make_resilience(chaos)
-        self._pending_resilience = None  # consumed
-        if pending is not None and res is not pending:
-            # pre-serve admit() calls ledgered their sheds (and deadline
-            # arming) on the pending object; carry them into the object
-            # this serve reports from so no rejection goes uncounted
-            res.sheds += pending.sheds
-            res._saw_deadline = res._saw_deadline or pending._saw_deadline
-        if chaos is not None:
-            res.chaos = chaos
-        chaos = res.chaos
-        sched.shed_policy = res.shed_policy
-        # ONE time base: submit stamps were taken with the scheduler's
-        # clock, so every sweep/drain decision reads the same clock — a
-        # mismatched engine.resilience_clock on a caller-built scheduler
-        # would otherwise make expired() compare across time bases
-        res.clock = sched.clock
-        # requests submitted straight to the scheduler (sched.submit, the
-        # PR 6 pattern) never passed res.admit: stamp config-default
-        # deadlines and arm the sweeps for any caller-set deadline_ms so
-        # the documented enforcement does not depend on the entry point
-        for r in list(sched.queue) + [s for s in sched.slots
-                                      if s is not None]:
-            res.stamp_deadline(r)
-        res_active = res.armed
-        guard = bool(res_active)
-        self._last_guard = guard
-        self.drained_requests = []
+        loop = self.start_serve(sched, temperature=temperature,
+                                top_k=top_k, seed=seed, chaos=chaos,
+                                resilience=resilience)
         session = ResilienceSession(self.model, signals_only=True)
         session.install_signal_handlers()
-        base_rng = jax.random.PRNGKey(seed)
-        step_no = 0
-        storm_seq = 0
-        draining = False
-        drain_deadline_ms = None
-        t0 = time.perf_counter()
         try:
             while True:
-                if not draining and session.preempted:
+                if session.preempted:
                     # flag-only handler fired: graceful drain — stop
                     # admitting, let in-flight requests finish inside the
                     # grace window, hand the queue back
-                    draining = True
-                    sched.draining = True
-                    res.drains += 1
-                    session.note_preemption(stats.decode_steps)
-                    drain_deadline_ms = res.clock() + \
-                        res.drain_grace_s * 1e3
-                    if tracer.enabled:
-                        tracer.event("serving_drain",
-                                     step=stats.decode_steps,
-                                     queued=sched.queued,
-                                     active=sched.active,
-                                     grace_s=res.drain_grace_s)
-                if draining and sched.active and \
-                        res.clock() > drain_deadline_ms:
-                    # grace exhausted: stragglers are evicted (outcome
-                    # preempted), never silently dropped
-                    for slot, r in enumerate(list(sched.slots)):
-                        if r is not None:
-                            sched.evict(slot, "preempted")
+                    loop.request_drain(session=session)
+                if not loop.tick():
                     break
-                if res_active and res.deadlines_armed:
-                    self._sweep_deadlines(sched, res, tracer)
-                action = sched.next_action()
-                if action is None:
-                    break
-                if action[0] == "prefill":
-                    _, req, slot, bucket = action
-                    if res_active and req.expired(res.clock()):
-                        # expired while queued but swept into a slot in
-                        # the same iteration: evict before paying prefill
-                        res.deadline_misses += 1
-                        sched.evict(slot, "deadline_exceeded")
-                        continue
-                    t_p = time.perf_counter()
-                    # effective prompt = prompt + committed tokens: empty
-                    # suffix for a fresh request, the full committed
-                    # stream for a decode-fault retry re-prefill
-                    eff = req.effective_len
-                    cur = req.current_prompt()
-                    ids = np.zeros((1, bucket), np.int32)
-                    ids[0, :eff] = cur
-                    _logits, last, cache = self._prefill_fn(bucket)(
-                        params, [jnp.asarray(ids)],
-                        jnp.asarray([eff], jnp.int32))
-                    self._ensure_state(cache)
-                    # per-request rng: deterministic under co-scheduling —
-                    # the stream depends on (submission tag, tokens
-                    # emitted), not slot timing; a retry resumes its
-                    # stream exactly where the quarantine cut it
-                    tag = req.rng_tag if req.rng_tag is not None \
-                        else req.rid
-                    tok = int(jax.device_get(
-                        sampler(last, base_rng,
-                                np.asarray([[tag, len(req.generated)]],
-                                           np.int32))[0]))
-                    wall = time.perf_counter() - t_p
-                    stats.prefills += 1
-                    stats.record_token(wall)
-                    stats.tokens_generated += 1
-                    if req.first_token_step is None:
-                        req.first_token_step = step_no
-                    if tracer.enabled:
-                        tracer.complete("prefill", wall, rid=req.rid,
-                                        bucket=bucket, slot=slot,
-                                        prompt_len=eff)
-                    if not sched.commit_token(slot, tok):
-                        self._write_slot(cache, slot, eff, tok)
-                    continue
-                # decode: one token for every live slot. Sampling covers
-                # ALL slots (free ones with a dummy rng, their draws
-                # discarded) so the sampler's shapes are as static as the
-                # decode step's — the whole loop compiles a bounded,
-                # occupancy-independent set of programs.
-                _, live = action
-                k = stats.decode_steps  # the chaos-script step index
-                if chaos is not None:
-                    chaos.maybe_preempt_serving(k)
-                    for p in chaos.maybe_storm(k):
-                        r = Request(prompt=np.asarray(p, np.int32),
-                                    max_new_tokens=(
-                                        chaos.storm_max_new_tokens),
-                                    eos_id=self.eos_id,
-                                    rng_tag=1_000_000 + storm_seq)
-                        storm_seq += 1
-                        try:
-                            res.admit(sched, r)
-                        except ServingRejection:
-                            pass  # counted by the controller; outcome shed
-                    if self.state is not None:
-                        self.state, poisoned = chaos.maybe_poison_decode(
-                            k, self.state)
-                        if poisoned is not None and tracer.enabled:
-                            tracer.event("decode_poison", step=k,
-                                         slot=poisoned)
-                t_d = time.perf_counter()
-                try:
-                    logits, ok_vec = self._dispatch_decode(
-                        params, res, chaos, k, guard, tracer)
-                except DecodeStateLostError:
-                    # the slot pool died with the device. Committed
-                    # tokens are host-side on each Request, so recovery
-                    # is the quarantine-retry path applied to EVERY live
-                    # stream: back to the queue front, re-prefilled onto
-                    # the rebuilt pool (rng streams key on (tag,
-                    # tokens_emitted) — continuations are unchanged). A
-                    # stream whose committed length outgrew the prefill
-                    # buckets cannot re-enter and is evicted (preempted).
-                    for slot, req in live:
-                        try:
-                            bucket_for(req.effective_len, sched.buckets)
-                        except ValueError:
-                            sched.evict(slot, "preempted")
-                            continue
-                        sched.quarantine(slot)
-                    self.state = None
-                    self._last_tokens = None
-                    if tracer.enabled:
-                        tracer.event("serving_state_rebuild", step=k,
-                                     requeued=len(live))
-                    continue
-                live_map = dict(live)
-                # per-slot rng streams depend on (submission tag, tokens
-                # emitted), never on slot index or batch composition —
-                # built as ONE host numpy array, folded in-jit
-                tag_counts = np.zeros((self.n_slots, 2), np.int32)
-                for s, r in live_map.items():
-                    tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
-                        else r.rid
-                    tag_counts[s, 1] = len(r.generated)
-                toks = sampler(logits, base_rng, tag_counts)
-                self._last_tokens = toks[:, None]
-                if ok_vec is not None:
-                    # the ONE extra transfer of the guarded step: the
-                    # per-slot finite verdict rides the same device_get
-                    toks_host, ok_host = jax.device_get((toks, ok_vec))
-                    toks_host = np.asarray(toks_host)
-                    ok_host = np.asarray(ok_host)
-                else:
-                    toks_host = np.asarray(jax.device_get(toks))
-                    ok_host = None
-                wall = time.perf_counter() - t_d
-                stats.decode_steps += 1
-                step_no += 1
-                if res_active:
-                    res.controller.observe_step(wall, len(live))
-                for slot, req in live:
-                    if ok_host is not None and not bool(ok_host[slot]):
-                        # poisoned slot: quarantine it alone — the token
-                        # is NOT committed, neighbors proceed untouched
-                        self._quarantine(sched, res, slot, req, tracer)
-                        continue
-                    stats.tokens_generated += 1
-                    stats.record_token(wall)
-                    sched.commit_token(slot, int(toks_host[slot]))
-                if tracer.enabled:
-                    tracer.complete("decode_step", wall, step=step_no,
-                                    live_slots=len(live))
-            if draining:
-                self.drained_requests = sched.pop_queued()
-                if tracer.enabled:
-                    tracer.event("serving_drain_done",
-                                 returned=len(self.drained_requests),
-                                 finished=len(sched.finished))
         finally:
             session.close()
-        stats.wall_s = time.perf_counter() - t0
-        # clean (outcome ok) completions only — evicted/failed requests
-        # are accounted in the outcome ledger below, not as "served"
-        stats.requests_served = sum(
-            1 for r in sched.finished if (r.outcome or "ok") == "ok")
-        stats.queue_depth_hwm = sched.queue_depth_hwm
-        # outcome ledger: every request that entered the system leaves
-        # under exactly one outcome
-        for r in sched.finished:
-            stats.count_outcome(r.outcome or "ok")
-        stats.count_outcome("shed", res.sheds)
-        stats.count_outcome("preempted", len(self.drained_requests))
-        stats.sheds = res.sheds
-        stats.deadline_misses = res.deadline_misses
-        stats.quarantines = res.quarantines
-        stats.decode_retries = res.decode_retries
-        stats.drains = res.drains
-        stats.replans = res.replans
-        stats.drained_returned = len(self.drained_requests)
-        self._merge_telemetry(sched, stats)
-        if tracer.enabled and self.model.config.trace_file:
-            tracer.write(self.model.config.trace_file)
-        return stats
+        return loop.finish()
 
     # ------------------------------------------------------ resilience hooks
+    def health_probe(self, prompt: Sequence[int] = (1, 2, 3)) -> bool:
+        """One prefill dispatch + finite-logits verdict, touching neither
+        the scheduler nor the slot-pool DecodeState: the fleet router's
+        active health check (ISSUE 11). A replica whose compute produces
+        non-finite next-token logits for a trivial prompt — or whose
+        dispatch raises — fails the probe; the circuit breaker decides
+        what that means. The probe reuses the smallest prefill bucket's
+        already-compiled program, so a steady-state probe costs one
+        dispatch, not a compile."""
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            bucket = self.buckets[0]
+            eff = max(1, min(len(prompt), bucket))
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :eff] = np.asarray(prompt[:eff], np.int32)
+            _logits, last, _cache = self._prefill_fn(bucket)(
+                self.model.params, [jnp.asarray(ids)],
+                jnp.asarray([eff], jnp.int32))
+            return bool(np.all(np.isfinite(
+                np.asarray(jax.device_get(last)))))
+        except Exception:
+            return False
+
+    def reset_decode_pool(self) -> None:
+        """Drop the slot-pool DecodeState (replica kill / rejoin in the
+        fleet): the next admission prefill rebuilds it from scratch via
+        ``_ensure_state`` — committed tokens live host-side on each
+        Request, so nothing user-visible is lost."""
+        self.state = None
+        self._last_tokens = None
+
     def _sweep_deadlines(self, sched, res, tracer) -> None:
         """Deadline enforcement at the iteration boundary: expired queued
         requests are dropped before they cost a prefill; expired in-flight
@@ -875,3 +702,288 @@ class ServingEngine:
                          mesh=list(plan.mesh_shape),
                          tokens_per_s=round(plan.sim_tokens_per_s, 1))
         return plan
+
+
+class _ServeLoop:
+    """One serve() run's loop state, advanced one scheduler action at a
+    time (ISSUE 11 refactor: the monolithic serve loop became
+    start_serve/tick/finish so the fleet router can interleave N
+    replicas' progress in a single host loop while each replica keeps
+    the exact PR 9 per-iteration semantics — deadline sweeps, guarded
+    decode, quarantine-retry, drain, device-loss failover).
+
+    Contract: ``tick()`` performs exactly one action (one prefill, or
+    one decode step advancing every live slot) and returns True;
+    returning False means the scheduler has nothing to do *right now* —
+    standalone ``serve()`` treats that as completion, the fleet may
+    dispatch more work and tick again. ``finish()`` closes the ledger
+    exactly once (idempotent)."""
+
+    def __init__(self, engine: ServingEngine,
+                 sched: ContinuousBatchScheduler,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 chaos=None, resilience=None,
+                 publish_telemetry: bool = True):
+        import jax
+
+        eng = self.engine = engine
+        self.sched = sched
+        self.publish_telemetry = publish_telemetry
+        self.tracer = eng._tracer()
+        self.params = eng.model.params
+        self.sampler = eng._sampler(temperature, top_k)
+        self.stats = eng.stats = ServingStats()
+        pending = eng._pending_resilience
+        res = self.res = resilience or pending or \
+            eng._make_resilience(chaos)
+        eng._pending_resilience = None  # consumed
+        if pending is not None and res is not pending:
+            # pre-serve admit() calls ledgered their sheds (and deadline
+            # arming) on the pending object; carry them into the object
+            # this serve reports from so no rejection goes uncounted
+            res.sheds += pending.sheds
+            res._saw_deadline = res._saw_deadline or pending._saw_deadline
+        if chaos is not None:
+            res.chaos = chaos
+        self.chaos = res.chaos
+        sched.shed_policy = res.shed_policy
+        # ONE time base: submit stamps were taken with the scheduler's
+        # clock, so every sweep/drain decision reads the same clock — a
+        # mismatched engine.resilience_clock on a caller-built scheduler
+        # would otherwise make expired() compare across time bases
+        res.clock = sched.clock
+        # requests submitted straight to the scheduler (sched.submit, the
+        # PR 6 pattern) never passed res.admit: stamp config-default
+        # deadlines and arm the sweeps for any caller-set deadline_ms so
+        # the documented enforcement does not depend on the entry point
+        for r in list(sched.queue) + [s for s in sched.slots
+                                      if s is not None]:
+            res.stamp_deadline(r)
+        self.res_active = res.armed
+        self.guard = bool(self.res_active)
+        eng._last_guard = self.guard
+        eng.drained_requests = []
+        self.base_rng = jax.random.PRNGKey(seed)
+        self.step_no = 0
+        self.storm_seq = 0
+        self.draining = False
+        self.drain_deadline_ms = None
+        self.finished = False
+        self.t0 = time.perf_counter()
+
+    # ---------------------------------------------------------------- drain
+    def request_drain(self, session=None) -> None:
+        """The graceful-drain transition (SIGTERM in serve(),
+        ``fleet.drain`` in the router): admission stops, in-flight
+        requests get the grace window, queued ones are handed back at
+        ``finish()``. Idempotent — repeat calls are no-ops."""
+        if self.draining:
+            return
+        sched, res = self.sched, self.res
+        self.draining = True
+        sched.draining = True
+        res.drains += 1
+        if session is not None:
+            session.note_preemption(self.stats.decode_steps)
+        self.drain_deadline_ms = res.clock() + res.drain_grace_s * 1e3
+        if self.tracer.enabled:
+            self.tracer.event("serving_drain",
+                              step=self.stats.decode_steps,
+                              queued=sched.queued, active=sched.active,
+                              grace_s=res.drain_grace_s)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> bool:
+        """Perform ONE scheduler action. Returns False when there is
+        nothing to do right now (queue empty + no live slot, or the
+        drain grace just expired and evicted the stragglers)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .resilience import DecodeStateLostError
+
+        eng, sched, res = self.engine, self.sched, self.res
+        stats, tracer, chaos = self.stats, self.tracer, self.chaos
+        if self.draining and sched.active and \
+                res.clock() > self.drain_deadline_ms:
+            # grace exhausted: stragglers are evicted (outcome
+            # preempted), never silently dropped
+            for slot, r in enumerate(list(sched.slots)):
+                if r is not None:
+                    sched.evict(slot, "preempted")
+            return False
+        if self.res_active and res.deadlines_armed:
+            eng._sweep_deadlines(sched, res, tracer)
+        action = sched.next_action()
+        if action is None:
+            return False
+        if action[0] == "prefill":
+            _, req, slot, bucket = action
+            if self.res_active and req.expired(res.clock()):
+                # expired while queued but swept into a slot in the same
+                # iteration: evict before paying prefill
+                res.deadline_misses += 1
+                sched.evict(slot, "deadline_exceeded")
+                return True
+            t_p = time.perf_counter()
+            # effective prompt = prompt + committed tokens: empty suffix
+            # for a fresh request, the full committed stream for a
+            # decode-fault retry (or cross-replica migration) re-prefill
+            eff = req.effective_len
+            cur = req.current_prompt()
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :eff] = cur
+            _logits, last, cache = eng._prefill_fn(bucket)(
+                self.params, [jnp.asarray(ids)],
+                jnp.asarray([eff], jnp.int32))
+            eng._ensure_state(cache)
+            # per-request rng: deterministic under co-scheduling — the
+            # stream depends on (submission tag, tokens emitted), not
+            # slot timing or the replica serving it; a retry/migration
+            # resumes its stream exactly where it stopped
+            tag = req.rng_tag if req.rng_tag is not None else req.rid
+            tok = int(jax.device_get(
+                self.sampler(last, self.base_rng,
+                             np.asarray([[tag, len(req.generated)]],
+                                        np.int32))[0]))
+            wall = time.perf_counter() - t_p
+            stats.prefills += 1
+            stats.record_token(wall)
+            stats.tokens_generated += 1
+            if req.first_token_step is None:
+                req.first_token_step = self.step_no
+            if tracer.enabled:
+                tracer.complete("prefill", wall, rid=req.rid,
+                                bucket=bucket, slot=slot, prompt_len=eff)
+            if not sched.commit_token(slot, tok):
+                eng._write_slot(cache, slot, eff, tok)
+            return True
+        # decode: one token for every live slot. Sampling covers ALL
+        # slots (free ones with a dummy rng, their draws discarded) so
+        # the sampler's shapes are as static as the decode step's — the
+        # whole loop compiles a bounded, occupancy-independent set of
+        # programs.
+        _, live = action
+        k = stats.decode_steps  # the chaos-script step index
+        if chaos is not None:
+            chaos.maybe_preempt_serving(k)
+            for p in chaos.maybe_storm(k):
+                r = Request(prompt=np.asarray(p, np.int32),
+                            max_new_tokens=chaos.storm_max_new_tokens,
+                            eos_id=eng.eos_id,
+                            rng_tag=1_000_000 + self.storm_seq)
+                self.storm_seq += 1
+                try:
+                    res.admit(sched, r)
+                except ServingRejection:
+                    pass  # counted by the controller; outcome shed
+            if eng.state is not None:
+                eng.state, poisoned = chaos.maybe_poison_decode(
+                    k, eng.state)
+                if poisoned is not None and tracer.enabled:
+                    tracer.event("decode_poison", step=k, slot=poisoned)
+        t_d = time.perf_counter()
+        try:
+            logits, ok_vec = eng._dispatch_decode(
+                self.params, res, chaos, k, self.guard, tracer)
+        except DecodeStateLostError:
+            # the slot pool died with the device. Committed tokens are
+            # host-side on each Request, so recovery is the
+            # quarantine-retry path applied to EVERY live stream: back
+            # to the queue front, re-prefilled onto the rebuilt pool
+            # (rng streams key on (tag, tokens_emitted) — continuations
+            # are unchanged). A stream whose committed length outgrew
+            # the prefill buckets cannot re-enter and is evicted
+            # (preempted).
+            for slot, req in live:
+                try:
+                    bucket_for(req.effective_len, sched.buckets)
+                except ValueError:
+                    sched.evict(slot, "preempted")
+                    continue
+                sched.quarantine(slot)
+            eng.state = None
+            eng._last_tokens = None
+            if tracer.enabled:
+                tracer.event("serving_state_rebuild", step=k,
+                             requeued=len(live))
+            return True
+        live_map = dict(live)
+        # per-slot rng streams depend on (submission tag, tokens
+        # emitted), never on slot index or batch composition — built as
+        # ONE host numpy array, folded in-jit
+        tag_counts = np.zeros((eng.n_slots, 2), np.int32)
+        for s, r in live_map.items():
+            tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
+                else r.rid
+            tag_counts[s, 1] = len(r.generated)
+        toks = self.sampler(logits, self.base_rng, tag_counts)
+        eng._last_tokens = toks[:, None]
+        if ok_vec is not None:
+            # the ONE extra transfer of the guarded step: the per-slot
+            # finite verdict rides the same device_get
+            toks_host, ok_host = jax.device_get((toks, ok_vec))
+            toks_host = np.asarray(toks_host)
+            ok_host = np.asarray(ok_host)
+        else:
+            toks_host = np.asarray(jax.device_get(toks))
+            ok_host = None
+        wall = time.perf_counter() - t_d
+        stats.decode_steps += 1
+        self.step_no += 1
+        if self.res_active:
+            res.controller.observe_step(wall, len(live))
+        for slot, req in live:
+            if ok_host is not None and not bool(ok_host[slot]):
+                # poisoned slot: quarantine it alone — the token is NOT
+                # committed, neighbors proceed untouched
+                eng._quarantine(sched, res, slot, req, tracer)
+                continue
+            stats.tokens_generated += 1
+            stats.record_token(wall)
+            sched.commit_token(slot, int(toks_host[slot]))
+        if tracer.enabled:
+            tracer.complete("decode_step", wall, step=self.step_no,
+                            live_slots=len(live))
+        return True
+
+    # --------------------------------------------------------------- finish
+    def finish(self) -> ServingStats:
+        """Close the run exactly once: drain handoff, the outcome ledger
+        (every request that entered the system leaves under exactly one
+        outcome), telemetry."""
+        eng, sched, res = self.engine, self.sched, self.res
+        stats, tracer = self.stats, self.tracer
+        if self.finished:
+            return stats
+        self.finished = True
+        if self.draining:
+            eng.drained_requests = sched.pop_queued()
+            if tracer.enabled:
+                tracer.event("serving_drain_done",
+                             returned=len(eng.drained_requests),
+                             finished=len(sched.finished))
+        stats.wall_s = time.perf_counter() - self.t0
+        # clean (outcome ok) completions only — evicted/failed requests
+        # are accounted in the outcome ledger below, not as "served"
+        stats.requests_served = sum(
+            1 for r in sched.finished if (r.outcome or "ok") == "ok")
+        stats.queue_depth_hwm = sched.queue_depth_hwm
+        # outcome ledger: every request that entered the system leaves
+        # under exactly one outcome
+        for r in sched.finished:
+            stats.count_outcome(r.outcome or "ok")
+        stats.count_outcome("shed", res.sheds)
+        stats.count_outcome("preempted", len(eng.drained_requests))
+        stats.sheds = res.sheds
+        stats.deadline_misses = res.deadline_misses
+        stats.quarantines = res.quarantines
+        stats.decode_retries = res.decode_retries
+        stats.drains = res.drains
+        stats.replans = res.replans
+        stats.drained_returned = len(eng.drained_requests)
+        if self.publish_telemetry:
+            eng._merge_telemetry(sched, stats)
+            if tracer.enabled and eng.model.config.trace_file:
+                tracer.write(eng.model.config.trace_file)
+        return stats

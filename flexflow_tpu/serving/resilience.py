@@ -236,6 +236,11 @@ class ServingResilience:
         self.max_replan_attempts = 3
         self.replan_backoff_s = 0.5
         self._saw_deadline = False
+        # fleet hook (ISSUE 11): the router arms the guarded decode on
+        # every replica it health-checks — a scripted degrade poisons a
+        # replica's DecodeState directly (no per-replica ChaosPlan), so
+        # the quarantine verdict must be live even when nothing else is
+        self.force_armed = False
 
     @property
     def armed(self) -> bool:
@@ -245,7 +250,7 @@ class ServingResilience:
         caller-set ``Request.deadline_ms`` arms it even with every config
         knob at its default (``deadlines_armed`` tracks the stamps)."""
         return bool(self.chaos is not None or self.shed_policy != "off"
-                    or self.deadlines_armed)
+                    or self.deadlines_armed or self.force_armed)
 
     # -------------------------------------------------------------- deadline
     @property

@@ -34,6 +34,19 @@ def now_ms() -> float:
     return time.monotonic() * 1e3
 
 
+def remove_by_identity(queue, req: "Request") -> bool:
+    """Remove ``req`` from a queue by IDENTITY (``is``), returning
+    whether it was found. The one implementation behind every queue
+    removal here and in the fleet router: Request is a dataclass holding
+    ndarrays, so ``list.remove`` / ``in`` (``==`` comparison) raise
+    ambiguous-truth mid-sweep."""
+    for i, q in enumerate(queue):
+        if q is req:
+            del queue[i]
+            return True
+    return False
+
+
 class ServingRejection(RuntimeError):
     """Common base of every admission refusal (ISSUE 9): the bounded-queue
     ``QueueFullError`` and the load shedder's ``OverloadError``
@@ -190,6 +203,9 @@ class ContinuousBatchScheduler:
         self.draining = False
         self.quarantined = 0
         self.evicted = 0
+        # hedge-loss cancellations (ISSUE 11): slots/queue entries freed
+        # WITHOUT a terminal outcome — the winning twin owns the ledger
+        self.cancelled = 0
 
     # ------------------------------------------------------------ admission
     @property
@@ -289,13 +305,7 @@ class ContinuousBatchScheduler:
         """Remove a still-queued request (it never held a slot) with a
         terminal ``outcome`` — the admission-time half of deadline
         enforcement."""
-        # Identity-based removal: Request is a dataclass holding ndarrays,
-        # so ``list.remove`` (== comparison) is ambiguous.
-        for i, q in enumerate(self.queue):
-            if q is req:
-                del self.queue[i]
-                break
-        else:
+        if not remove_by_identity(self.queue, req):
             raise ValueError(f"request rid={req.rid} is not queued")
         req.done = True
         req.finish_reason = outcome
@@ -316,6 +326,41 @@ class ContinuousBatchScheduler:
         self.quarantined += 1
         self.queue.appendleft(req)
         return req
+
+    def cancel_slot(self, slot: int) -> Request:
+        """Hedge-loss cancellation (ISSUE 11, serving/fleet.py): free the
+        slot WITHOUT a terminal outcome and WITHOUT a ``finished`` entry —
+        the cancelled copy is accounted by its winning hedge twin, so a
+        ledger entry here would double-count the request. The slot's
+        cache rows go stale exactly like an eviction's; the next prefill
+        fully overwrites them before any read (the standing slot-pool
+        invariant)."""
+        req = self.slots[slot]
+        assert req is not None, f"cancel of empty slot {slot}"
+        self.slots[slot] = None
+        self._free.append(slot)
+        self.cancelled += 1
+        return req
+
+    def cancel_queued(self, req: Request) -> None:
+        """Hedge-loss cancellation for a copy that never held a slot:
+        identity-based removal from the queue, no ledger entry."""
+        if not remove_by_identity(self.queue, req):
+            raise ValueError(f"request rid={req.rid} is not queued")
+        self.cancelled += 1
+
+    def remove_finished(self, req: Request) -> bool:
+        """Strike a request from the ``finished`` ledger (identity-based):
+        the hedge loser may complete in the same router tick its twin
+        wins, and exactly-one-outcome accounting then requires the
+        loser's entry withdrawn. Returns True when an entry was
+        removed."""
+        for i, q in enumerate(self.finished):
+            if q is req:
+                del self.finished[i]
+                self.cancelled += 1
+                return True
+        return False
 
     def pop_queued(self) -> List[Request]:
         """Drain handoff: hand back every still-queued request (outcome

@@ -243,6 +243,34 @@ def summarize_telemetry(data, top: int) -> None:
 
     _block(data, "serving_resilience", _srvres)
 
+    def _fleet(fl):
+        # fleet headline (ISSUE 11): the multi-replica router's ledger,
+        # how traffic split across fault domains, and how hard the
+        # failover/hedging/health machinery worked
+        oc = fl.get("outcomes", {})
+        parts = [f"{k}={oc[k]}" for k in
+                 ("ok", "deadline_exceeded", "shed", "decode_fault",
+                  "preempted") if oc.get(k)]
+        print(f"fleet: {fl.get('replicas', 0)} replicas, "
+              f"{fl.get('requests', 0)} requests, "
+              f"{fl.get('tokens_generated', 0)} tokens over "
+              f"{fl.get('ticks', 0)} ticks   "
+              + (" ".join(parts) or "no outcomes"))
+        line = f"  dispatches: {fl.get('dispatches', [])}"
+        if fl.get("shed_rate"):
+            line += f"   shed rate {fl['shed_rate']}"
+        print(line)
+        if (fl.get("failovers") or fl.get("migrations")
+                or fl.get("hedges") or fl.get("circuit_opens")):
+            print(f"  failovers: {fl.get('failovers', 0)}   "
+                  f"migrations: {fl.get('migrations', 0)}   "
+                  f"hedges: {fl.get('hedges', 0)} "
+                  f"(twin wins {fl.get('hedge_twin_wins', 0)})   "
+                  f"circuit opens: {fl.get('circuit_opens', 0)}   "
+                  f"probes: {fl.get('probes', 0)}")
+
+    _block(data, "fleet", _fleet)
+
     def _loss(losses):
         show = losses[:top]
         print(f"loss: first {len(show)} of {len(losses)}: "
