@@ -575,6 +575,20 @@ def serving_leg() -> dict:
             st.batch_occupancy(eng.n_slots), 3)
         out["serving_requests"] = st.requests_served
         out["serving_decode_compiles"] = eng.decode_compiles
+        # decode HBM traffic column (ISSUE 12): analytic KV bytes-read
+        # per token on the paged path, vs what the same workload costs
+        # on the O(max_len) ring — kv_fill is the measured mean block
+        # occupancy the simulated paged-vs-ring ratio reprices with
+        out["serving_kv_cache"] = eng.kv_cache
+        kvpt = st.kv_bytes_per_token()
+        ring_bytes = eng.n_slots * eng.max_decode_len * \
+            eng._kv_row_bytes()
+        ring_per_token = (ring_bytes * st.decode_steps /
+                          max(st.tokens_generated, 1))
+        if kvpt is not None:
+            out["serving_kv_bytes_per_token"] = round(kvpt, 1)
+            out["serving_kv_fill"] = round(kvpt / ring_per_token, 4) \
+                if ring_per_token else None
         # serving_degraded sub-leg (ISSUE 9, docs/serving.md "Serving
         # under failure"): the same workload under a scripted ~20%
         # decode-poison chaos mix plus a mid-run queue storm through the
@@ -611,9 +625,39 @@ def serving_leg() -> dict:
                 f"{type(e).__name__}: {e}"[:160]
         finally:
             config.shed_policy = "off"
+        # speculative-decoding sub-leg (ISSUE 12): a 2-layer drafter
+        # proposes, the 12-layer target verifies through the exact score
+        # path — acceptance-rate and tokens/s next to the plain decode
+        try:
+            from flexflow_tpu.serving import SpeculativeDecoder
+
+            d_cfg = GPT2Config(batch_size=8, seq_len=256, hidden=192,
+                               num_heads=12, num_layers=2,
+                               intermediate=768,
+                               vocab_size=cfg.vocab_size)
+            d_config = FFConfig()
+            d_config.batch_size = d_cfg.batch_size
+            drafter = FFModel(d_config)
+            build_gpt2(drafter, d_cfg)
+            drafter.compile(
+                optimizer=AdamOptimizer(drafter, alpha=1e-4),
+                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+            spec = SpeculativeDecoder(ff, drafter, gamma=4,
+                                      max_context=256,
+                                      controller=eng.admission)
+            spec.generate(prompts[:8], max_new_tokens=32)
+            ss = spec.stats
+            out["serving_spec_acceptance"] = round(
+                ss.acceptance_rate() or 0.0, 4)
+            out["serving_spec_tokens_per_s"] = round(
+                ss.tokens_per_s(), 1)
+            out["serving_spec_rounds"] = ss.spec_rounds
+        except Exception as e:  # the spec sub-leg must not sink the rest
+            out["serving_spec_leg_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
         # simulated serving objective at 8 chips: the searched plan's
         # tokens/sec against naive dp replication (ranked always carries
-        # the (8, 1) replicated point)
+        # the (8, 1) replicated point); kv_dtype rides the sweep
         plan = serving_search(ff.pcg, config, 8,
                               machine=TPUMachineModel.from_generation(
                                   "v5e", 8))
@@ -621,11 +665,30 @@ def serving_leg() -> dict:
         out["serving_sim_p99_ms"] = round(plan.sim_p99_ms, 3)
         out["serving_sim_mesh"] = list(plan.mesh_shape)
         out["serving_sim_kv_layout"] = plan.layout
+        out["serving_sim_kv_dtype"] = plan.kv_dtype
         naive = [c for c in plan.ranked
-                 if tuple(c.mesh_shape) == (8, 1)]
+                 if tuple(c.mesh_shape) == (8, 1)
+                 and c.kv_dtype == "native"]
         if naive:
             out["serving_sim_vs_naive_dp"] = round(
                 plan.sim_tokens_per_s / naive[0].sim_tokens_per_s, 3)
+        # simulated paged-vs-ring decode ratio (the PR 10/11 convention:
+        # the acceptance target is MEASURED on TPU, the simulated ratio
+        # is recorded every round on CPU): the ring prices the KV read
+        # at full max_len fill, the paged path at the MEASURED mean
+        # block occupancy of the run above
+        fill = out.get("serving_kv_fill")
+        if fill:
+            ring_plan = serving_search(
+                ff.pcg, config, 8, kv_fill=1.0,
+                machine=TPUMachineModel.from_generation("v5e", 8))
+            paged_plan = serving_search(
+                ff.pcg, config, 8, kv_fill=float(fill),
+                machine=TPUMachineModel.from_generation("v5e", 8))
+            if paged_plan.sim_tokens_per_s > 0:
+                out["serving_sim_paged_speedup"] = round(
+                    paged_plan.sim_tokens_per_s /
+                    ring_plan.sim_tokens_per_s, 3)
     except Exception as e:
         out["serving_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out

@@ -28,15 +28,16 @@ from .lattice import Placement  # noqa: F401
 from .report import (AnalysisReport, Diagnostic,  # noqa: F401
                      StaticAnalysisError)
 from .rules import (RULES, BufferRef, DonationSpec,  # noqa: F401
-                    check_donation, check_remat, check_rng_streams,
-                    check_serving_graph, check_shapes,
+                    check_donation, check_paged_kv, check_remat,
+                    check_rng_streams, check_serving_graph, check_shapes,
                     donation_spec_for_training)
 
 __all__ = [
     "AnalysisReport", "Diagnostic", "StaticAnalysisError", "Placement",
     "InterpResult", "interpret", "RULES", "BufferRef", "DonationSpec",
-    "check_donation", "check_remat", "check_rng_streams",
-    "check_serving_graph", "check_shapes", "donation_spec_for_training",
+    "check_donation", "check_paged_kv", "check_remat",
+    "check_rng_streams", "check_serving_graph", "check_shapes",
+    "donation_spec_for_training",
     "analyze_strategy", "analyze_candidate", "analyze_model",
 ]
 

@@ -326,3 +326,73 @@ def check_shapes(pcg, strategy) -> List[Diagnostic]:
                       if node is not None and node.out_shapes else None)
             check_spec(name, f"{name} output", ns.output_spec, oshape)
     return out
+
+
+def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
+                   max_blocks_per_slot: int, max_context: int,
+                   kv_layout: str = "replicated",
+                   tp: int = 1) -> List[Diagnostic]:
+    """FF006 extension (ISSUE 12): static shape laws of a paged-KV
+    serving configuration — judged with ZERO compile, so a misconfigured
+    layout is rejected at engine construction (or plan lint), not by an
+    opaque scatter failure ten decode steps in.
+
+    * ``block_size`` must be positive, and the pool must be whole blocks
+      with at least one usable block past the reserved garbage block;
+    * the pool must hold at least one max-context request — anything
+      smaller deadlocks admission by construction;
+    * the block TABLE must cover the max supported context
+      (``max_blocks_per_slot * block_size >= max_context``): a shorter
+      table would silently truncate a legal request's KV extent;
+    * under a heads-sharded KV layout every attention node's head count
+      must divide ``tp`` — the per-chip pool shard otherwise splits a
+      head's rows across chips.
+    """
+    out: List[Diagnostic] = []
+    hint = ("fix the paged-KV knobs (--kv-block-size / --kv-pool-blocks "
+            "/ --max-decode-len) so the block table and pool cover the "
+            "supported context")
+    if block_size < 1:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=f"paged KV: block_size must be >= 1 (got "
+                    f"{block_size})", fix_hint=hint))
+        return out
+    if pool_blocks < 2:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=(f"paged KV: pool has {pool_blocks} block(s); needs "
+                     ">= 2 (the reserved garbage block + at least one "
+                     "usable block)"), fix_hint=hint))
+    need = -(-int(max_context) // int(block_size))
+    if pool_blocks - 1 < need:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=(f"paged KV: pool's {pool_blocks - 1} usable blocks "
+                     f"({(pool_blocks - 1) * block_size} tokens) cannot "
+                     f"hold one max-context request ({max_context} "
+                     "tokens) — admission would deadlock"),
+            fix_hint=hint))
+    if max_blocks_per_slot * block_size < max_context:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=(f"paged KV: block table covers "
+                     f"{max_blocks_per_slot * block_size} tokens "
+                     f"({max_blocks_per_slot} blocks x {block_size}) "
+                     f"< max supported context {max_context}"),
+            fix_hint=hint))
+    if kv_layout == "sharded" and tp > 1 and pcg is not None:
+        for node in pcg.compute_nodes():
+            if node.op.op_type != OperatorType.OP_MULTIHEAD_ATTENTION:
+                continue
+            heads = int(node.op.attrs.get("num_heads", 1))
+            if heads % tp:
+                out.append(Diagnostic(
+                    rule_id="FF006", node=node.name,
+                    message=(f"paged KV: heads-sharded layout needs "
+                             f"num_heads ({heads}) divisible by tp "
+                             f"({tp}); a pool block's head axis cannot "
+                             "split a head across chips"),
+                    fix_hint="use the replicated KV layout or a tp that "
+                             "divides num_heads"))
+    return out

@@ -219,6 +219,25 @@ class FFConfig:
     # serving-objective SLO: simulated p99 per-token latency bound (ms) for
     # search_all(objective="serving"); 0 = throughput-only
     slo_p99_ms: float = 0.0
+    # paged KV cache (flexflow_tpu/serving/kvcache.py, docs/serving.md
+    # "Paged KV cache" + docs/decode_perf.md; ISSUE 12).
+    # KV-cache layout: "paged" (block pool + per-slot block tables —
+    # slot recycling is pointer bookkeeping, decode attention reads
+    # O(true_length) through the flash-decode kernel) or "ring" (the
+    # legacy per-slot max_len buffers)
+    kv_cache: str = "paged"
+    # tokens per KV block of the paged layout
+    kv_block_size: int = 16
+    # paged pool size in blocks (incl. the reserved garbage block);
+    # 0 = auto (every slot can hold max_decode_len). Setting it smaller
+    # decouples pool occupancy from max_decode_len: admission then waits
+    # on free BLOCKS, not just free slots
+    kv_pool_blocks: int = 0
+    # KV storage dtype: "native" (model dtype; also lets the serving
+    # search sweep the int8 axis) or "int8" (pin symmetric per-(token,
+    # head) int8 with f32 scales — ~1/el the decode KV bandwidth, judged
+    # against a pinned tolerance band instead of the bitwise contract)
+    kv_dtype: str = "native"
     # serving resilience (flexflow_tpu/serving/resilience.py,
     # docs/serving.md "Serving under failure"; ISSUE 9).
     # Per-request completion deadline (ms from submission) defaulted onto
@@ -441,6 +460,22 @@ class FFConfig:
                 self.max_inflight = int(_next())
             elif a == "--slo-p99-ms":
                 self.slo_p99_ms = float(_next())
+            elif a == "--kv-cache":
+                v = _next()
+                if v not in ("paged", "ring"):
+                    raise ValueError(
+                        f"--kv-cache expects paged|ring, got {v!r}")
+                self.kv_cache = v
+            elif a == "--kv-block-size":
+                self.kv_block_size = int(_next())
+            elif a == "--kv-pool-blocks":
+                self.kv_pool_blocks = int(_next())
+            elif a == "--kv-dtype":
+                v = _next()
+                if v not in ("native", "int8"):
+                    raise ValueError(
+                        f"--kv-dtype expects native|int8, got {v!r}")
+                self.kv_dtype = v
             elif a == "--request-timeout-ms":
                 self.request_timeout_ms = float(_next())
             elif a == "--shed-policy":
@@ -534,6 +569,25 @@ class FFConfig:
             raise ValueError(
                 f"--slo-p99-ms must be >= 0 (got {self.slo_p99_ms}); "
                 "0 disables the latency bound")
+        if "--kv-block-size" in seen and self.kv_block_size < 1:
+            raise ValueError(
+                f"--kv-block-size must be >= 1 (got "
+                f"{self.kv_block_size}): it is the token granularity of "
+                "the paged KV pool")
+        if "--kv-pool-blocks" in seen and self.kv_pool_blocks < 0:
+            raise ValueError(
+                f"--kv-pool-blocks must be >= 0 (got "
+                f"{self.kv_pool_blocks}); 0 sizes the pool automatically "
+                "(every slot can hold max_decode_len)")
+        if "--kv-pool-blocks" in seen and self.kv_cache == "ring":
+            raise ValueError(
+                "--kv-pool-blocks is only meaningful with --kv-cache "
+                "paged; drop it or switch the layout")
+        if "--kv-dtype" in seen and self.kv_dtype != "native" and \
+                self.kv_cache == "ring":
+            raise ValueError(
+                "--kv-dtype int8 requires --kv-cache paged (the ring "
+                "layout stores the model dtype only)")
         if "--request-timeout-ms" in seen and self.request_timeout_ms < 0:
             raise ValueError(
                 f"--request-timeout-ms must be >= 0 (got "

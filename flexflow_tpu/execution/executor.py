@@ -890,7 +890,8 @@ class Executor:
         return fn
 
     def make_decode_step(self, max_decode_len: int, exact: bool = False,
-                         guard: bool = False):
+                         guard: bool = False, block_size: int = 0,
+                         kv_dtype: str = "native"):
         """Jitted ``(params, xs, state) -> (logits, new_state)``: ONE token
         per slot through the graph, consuming and extending the
         ``DecodeState`` ring buffers at each slot's ``lengths`` cursor.
@@ -908,10 +909,17 @@ class Executor:
         per step. The logits themselves are untouched: a poisoned slot's
         quarantine decision is the HOST's (serving/resilience.py), and
         every healthy slot's values stay bitwise-identical to the
-        unguarded step's."""
+        unguarded step's.
+
+        Paged KV (ISSUE 12): when the carried ``DecodeState`` has block
+        tables, ``block_size``/``kv_dtype`` select the paged layout —
+        the tables ride the jitted signature as one more int32 array, so
+        the single-compile contract is unchanged (ring and paged are
+        distinct programs, each compiled once)."""
         import jax
 
-        key = ("decode", int(max_decode_len), bool(exact), bool(guard))
+        key = ("decode", int(max_decode_len), bool(exact), bool(guard),
+               int(block_size), str(kv_dtype))
         cached = self._serving_jits.get(key)
         if cached is not None:
             return cached
@@ -927,7 +935,10 @@ class Executor:
             params, xs = self._cast_for_compute(params, xs)
             sv = ServingState(mode="decode", max_len=max_decode_len,
                               positions=state.lengths,
-                              cache_in=state.caches, exact=exact)
+                              cache_in=state.caches, exact=exact,
+                              block_tables=state.block_tables,
+                              block_size=int(block_size),
+                              kv_dtype=str(kv_dtype))
             ctx = OpContext(training=False, rng=None, mesh=mesh,
                             profiling=profiling, serving=sv)
             values = self.forward_outputs(
@@ -937,7 +948,8 @@ class Executor:
             logits = self._logits_f32(
                 values[self.final_guid][self.final_out_idx])[:, 0]
             new_state = DecodeState(caches=sv.cache_out,
-                                    lengths=state.lengths + 1)
+                                    lengths=state.lengths + 1,
+                                    block_tables=state.block_tables)
             if guard:
                 ok = jnp.all(jnp.isfinite(logits), axis=-1)
                 return logits, new_state, ok

@@ -185,12 +185,27 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
       ``positions[slot]`` (per-slot dynamic_update_slice — static shapes,
       no recompile) and q attends over the full buffer under the mask
       ``key_pos <= position``.
+
+    Paged decode (ISSUE 12, ``sv.paged``): the per-slot ring becomes a
+    block pool + per-slot block tables (serving/kvcache.py). The token
+    write is a pool scatter at (table[pos // bs], pos % bs); the read is
+    either the Pallas flash-decode kernel (TPU fast path — O(true
+    length) HBM traffic, kernels/flash_decode.py) or a pure gather back
+    to position order followed by EXACTLY the ring math below — gathered
+    rows are bitwise the stored rows and garbage-block rows are masked
+    to exact zeros, so paged fp decode stays bitwise-identical to the
+    ring (and, under ``sv.exact``, to the whole-sequence forward). The
+    int8 layout dequantizes per-(token, head) rows on read and is judged
+    against a pinned tolerance band instead.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from ..serving.kvcache import write_token_kv
+    from ..serving.kvcache import (dequantize_kv, gather_paged_kv,
+                                   gather_paged_scales, quantize_kv,
+                                   write_token_kv, write_token_kv_paged,
+                                   write_token_scale_paged)
 
     if not causal:
         raise ValueError(
@@ -206,18 +221,52 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
             (0, 0, 0, 0))
         sv.cache_out[name] = (kbuf, vbuf)
         return mha_core(q, k, v, causal=True)
-    kc, vc = sv.cache_in[name]
-    kc = write_token_kv(kc, k, sv.positions)
-    vc = write_token_kv(vc, v, sv.positions)
-    sv.cache_out[name] = (kc, vc)
     scale = 1.0 / np.sqrt(q.shape[-1])
+    if sv.paged:
+        tables, bs = sv.block_tables, sv.block_size
+        if sv.kv_dtype == "int8":
+            kq, ks, vq, vs = sv.cache_in[name]
+            k_new, ks_new = quantize_kv(k)   # (S,h,1,hd) -> scale (S,h,1)
+            v_new, vs_new = quantize_kv(v)
+            kq = write_token_kv_paged(kq, k_new, sv.positions, tables, bs)
+            ks = write_token_scale_paged(ks, ks_new, sv.positions, tables,
+                                         bs)
+            vq = write_token_kv_paged(vq, v_new, sv.positions, tables, bs)
+            vs = write_token_scale_paged(vs, vs_new, sv.positions, tables,
+                                         bs)
+            sv.cache_out[name] = (kq, ks, vq, vs)
+            kernel_out = _maybe_flash_decode(
+                q, (kq, ks, vq, vs), tables, sv, scale)
+            if kernel_out is not None:
+                return kernel_out
+            kc = dequantize_kv(gather_paged_kv(kq, tables),
+                               gather_paged_scales(ks, tables), k.dtype)
+            vc = dequantize_kv(gather_paged_kv(vq, tables),
+                               gather_paged_scales(vs, tables), v.dtype)
+        else:
+            kp, vp = sv.cache_in[name]
+            kp = write_token_kv_paged(kp, k, sv.positions, tables, bs)
+            vp = write_token_kv_paged(vp, v, sv.positions, tables, bs)
+            sv.cache_out[name] = (kp, vp)
+            kernel_out = _maybe_flash_decode(q, (kp, vp), tables, sv,
+                                             scale)
+            if kernel_out is not None:
+                return kernel_out
+            kc = gather_paged_kv(kp, tables)
+            vc = gather_paged_kv(vp, tables)
+    else:
+        kc, vc = sv.cache_in[name]
+        kc = write_token_kv(kc, k, sv.positions)
+        vc = write_token_kv(vc, v, sv.positions)
+        sv.cache_out[name] = (kc, vc)
+    extent = kc.shape[2]  # max_len (ring) | blocks * block_size (paged)
     if sv.exact:
         # bitwise mode: the 1-token q rides a full-extent score GEMM (its
         # row is extracted afterwards) so the d-axis accumulation order
         # matches the whole-sequence forward exactly; the fast path below
         # lowers to a matvec that differs by ~1 ulp
         qpad = write_token_kv(
-            jnp.zeros(kc.shape[:2] + (sv.max_len, q.shape[-1]), q.dtype),
+            jnp.zeros(kc.shape[:2] + (extent, q.shape[-1]), q.dtype),
             q, sv.positions)
         full = jnp.einsum("bhqd,bhkd->bhqk", qpad, kc,
                           preferred_element_type=jnp.float32) * scale
@@ -226,13 +275,36 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
     else:
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
                             preferred_element_type=jnp.float32) * scale
-    kpos = jnp.arange(sv.max_len)
+    kpos = jnp.arange(extent)
     mask = kpos[None, None, None, :] <= sv.positions[:, None, None, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,
                      preferred_element_type=jnp.float32)
     return out.astype(vc.dtype)
+
+
+def _maybe_flash_decode(q, entry, tables, sv, sm_scale):
+    """Route one paged decode read through the Pallas flash-decode kernel
+    when eligible (on-TPU, non-exact numerics, MXU-friendly dims) —
+    returns the (S, h, 1, hd) output or None for the gather fallback.
+    Consults ``_flash_tuning(kernel="flash_decode")`` so an unmeasured
+    chip generation warns once for THIS kernel (ISSUE 12 satellite)."""
+    from ..kernels.flash_decode import flash_decode, use_flash_decode
+
+    if sv.exact or not use_flash_decode(q.shape[-1], sv.block_size):
+        return None
+    _flash_tuning(kernel="flash_decode")  # per-(generation, kernel) warn
+    n_keys = sv.positions + 1
+    if sv.kv_dtype == "int8":
+        kq, ks, vq, vs = entry
+        out = flash_decode(q[:, :, 0, :], kq, vq, tables, n_keys,
+                           sm_scale=sm_scale, kscale=ks, vscale=vs)
+    else:
+        kp, vp = entry
+        out = flash_decode(q[:, :, 0, :], kp, vp, tables, n_keys,
+                           sm_scale=sm_scale)
+    return out[:, :, None, :]
 
 
 def _dropout_seed(rng):
@@ -282,43 +354,57 @@ FLASH_TUNING = {
     # running the recipe above on that chip)
     "v5e": {"block_q_cap": 512, "block_k_cap": 1024, "min_block": 256},
 }
-_tuning_cache = {}
+_tuning_cache: dict = {}
 
 
-def _flash_tuning() -> dict:
+def _detect_tpu_generation():
+    """(on_tpu, generation) of the process's first device — one probe,
+    cached; the shared detection behind every kernel's tuning lookup
+    (monkeypatch point for the warn-once tests)."""
+    gen = None
+    on_tpu = False
+    try:
+        import jax
+
+        from ..search.machine_model import detect_generation
+
+        dev = jax.devices()[0]
+        on_tpu = dev.platform == "tpu"
+        gen = detect_generation(dev.device_kind)
+    except Exception:
+        pass
+    return on_tpu, gen
+
+
+def _flash_tuning(kernel: str = "flash_attention") -> dict:
     """The FLASH_TUNING row for the current chip (device_kind normalized by
     machine_model.detect_generation — the one shared matcher; v5e's
     measured row is the default for unknown kinds). When an UNMEASURED TPU
-    generation inherits the v5e row, warn once: if flash kernels regress
-    on that chip, the trace must point at the tuning table, not the
-    kernels (ADVICE r5)."""
-    if "row" not in _tuning_cache:
-        gen = None
-        on_tpu = False
-        try:
-            import jax
+    generation inherits the v5e row, warn once PER (generation, kernel) —
+    not once per process (ISSUE 12 satellite: the old module-level
+    warn-once meant a v5e-tuned tile row inherited by another generation
+    was silenced for the flash-DECODE kernel after the first training
+    warning): if a flash kernel regresses on that chip, the trace must
+    point at the tuning table, not the kernels (ADVICE r5)."""
+    if "probe" not in _tuning_cache:
+        _tuning_cache["probe"] = _detect_tpu_generation()
+        _tuning_cache["warned"] = set()
+    on_tpu, gen = _tuning_cache["probe"]
+    if on_tpu and gen not in FLASH_TUNING and \
+            (gen, kernel) not in _tuning_cache["warned"]:
+        import warnings
 
-            from ..search.machine_model import detect_generation
-
-            dev = jax.devices()[0]
-            on_tpu = dev.platform == "tpu"
-            gen = detect_generation(dev.device_kind)
-        except Exception:
-            pass
-        if on_tpu and gen not in FLASH_TUNING:
-            import warnings
-
-            warnings.warn(
-                f"flash-attention tile table has no MEASURED row for TPU "
-                f"generation {gen!r}; inheriting the v5e tiling (block_q "
-                f"{FLASH_TUNING['v5e']['block_q_cap']} / block_k "
-                f"{FLASH_TUNING['v5e']['block_k_cap']} / min_block "
-                f"{FLASH_TUNING['v5e']['min_block']}) as an unmeasured "
-                f"estimate — on-chip regressions are traceable here; "
-                f"re-measure per the FLASH_TUNING recipe and add a row.",
-                stacklevel=2)
-        _tuning_cache["row"] = FLASH_TUNING.get(gen, FLASH_TUNING["v5e"])
-    return _tuning_cache["row"]
+        _tuning_cache["warned"].add((gen, kernel))
+        warnings.warn(
+            f"{kernel}: flash tile table has no MEASURED row for TPU "
+            f"generation {gen!r}; inheriting the v5e tiling (block_q "
+            f"{FLASH_TUNING['v5e']['block_q_cap']} / block_k "
+            f"{FLASH_TUNING['v5e']['block_k_cap']} / min_block "
+            f"{FLASH_TUNING['v5e']['min_block']}) as an unmeasured "
+            f"estimate — on-chip regressions are traceable here; "
+            f"re-measure per the FLASH_TUNING recipe and add a row.",
+            stacklevel=2)
+    return FLASH_TUNING.get(gen, FLASH_TUNING["v5e"])
 
 
 def _flash_blocks(seq_q: int, seq_k: int):

@@ -6,11 +6,14 @@ objective (latency-bounded throughput) next to the training step-time
 search. The reference snapshot shipped only an incomplete Triton serving
 prototype; this subsystem is that story finished in JAX.
 """
-from .kvcache import DecodeState, ServingState  # noqa: F401
-from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
-                        Request, ServingRejection, bucket_for,
-                        default_buckets)
+from .kvcache import (DecodeState, GARBAGE_BLOCK,  # noqa: F401
+                      KV_DTYPES, ServingState)
+from .scheduler import (BlockAllocator,  # noqa: F401
+                        ContextOverflowError, ContinuousBatchScheduler,
+                        QueueFullError, Request, ServingRejection,
+                        bucket_for, default_buckets)
 from .engine import ServingEngine, ServingStats  # noqa: F401
+from .speculative import SpeculativeDecoder  # noqa: F401
 from .resilience import (AdmissionController,  # noqa: F401
                          DecodeStateLostError, DeviceLossError,
                          OUTCOMES, OverloadError, ServingResilience)

@@ -33,6 +33,25 @@ from .kvcache import DecodeState, update_slot_entry
 from .scheduler import (ContinuousBatchScheduler, Request, ServingRejection,
                         bucket_for, default_buckets)
 
+def position_context_bound(executor, max_len: int) -> int:
+    """The max supported context of a compiled autoregressive model:
+    ``max_len`` bounded by the position-embedding table wherever one
+    exists — positions beyond the table would CLAMP under jit
+    (``jnp.take``) and silently reuse the last row's embedding. ONE
+    implementation for every consumer (the serving engine's admission
+    rejection AND the speculative decoder's scoring bound — ISSUE 12
+    removed the old warn-and-clamp precisely so nothing aliases rows)."""
+    bound = int(max_len)
+    pos_guids = set(executor._position_const_guids())
+    for node in executor.pcg.compute_nodes():
+        if node.op.op_type == OperatorType.OP_EMBEDDING and any(
+                g in pos_guids for g, _ in node.inputs):
+            entries = int(node.op.attrs.get("num_entries", 0))
+            if entries:
+                bound = min(bound, entries)
+    return bound
+
+
 # per-token latency reservoir bound (ISSUE 9 satellite): the old unbounded
 # list grew one float per token for the life of the serve loop — a
 # traffic-serving process leaks. p50/p99 are computed over a sliding
@@ -68,9 +87,31 @@ class ServingStats:
     drains: int = 0
     replans: int = 0
     drained_returned: int = 0
+    # decode HBM traffic accounting (ISSUE 12): analytic KV bytes the
+    # decode attention reads, accumulated per step host-side — paged
+    # engines charge each live slot's OCCUPIED blocks, ring engines the
+    # full n_slots * max_len extent (the O(max_len) bill the paged
+    # refactor removes); bench's bytes-read/token column
+    kv_bytes_read: int = 0
+    # speculative decoding (serving/speculative.py): per-round drafter
+    # proposal/acceptance ledger; acceptance_rate feeds the bench column
+    # and keeps the EWMA admission cost model honest
+    spec_rounds: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def record_token(self, wall_s: float) -> None:
         self.token_walls_s.append(wall_s)
+
+    def kv_bytes_per_token(self) -> Optional[float]:
+        if not self.tokens_generated or not self.kv_bytes_read:
+            return None
+        return self.kv_bytes_read / self.tokens_generated
+
+    def acceptance_rate(self) -> Optional[float]:
+        if not self.spec_proposed:
+            return None
+        return self.spec_accepted / self.spec_proposed
 
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         if n:
@@ -116,10 +157,16 @@ class ServingStats:
             out["outcomes"] = dict(self.outcomes)
         for k in ("sheds", "deadline_misses", "quarantines",
                   "decode_retries", "drains", "replans",
-                  "drained_returned"):
+                  "drained_returned", "spec_rounds"):
             v = getattr(self, k)
             if v:
                 out[k] = v
+        kvpt = self.kv_bytes_per_token()
+        if kvpt is not None:
+            out["kv_bytes_per_token"] = round(kvpt, 1)
+        acc = self.acceptance_rate()
+        if acc is not None:
+            out["spec_acceptance"] = round(acc, 4)
         return out
 
 
@@ -140,7 +187,11 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: int = 64,
                  eos_id: Optional[int] = None,
-                 exact_decode: bool = False):
+                 exact_decode: bool = False,
+                 kv_cache: Optional[str] = None,
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         assert model.executor is not None, "call model.compile() first"
         self.model = model
         self.executor = model.executor
@@ -148,20 +199,79 @@ class ServingEngine:
         self.n_slots = int(n_slots or getattr(cfg, "max_inflight", 8))
         self.max_decode_len = int(max_decode_len or
                                   getattr(cfg, "max_decode_len", 128))
-        # pre-clamp value, so FFModel.generate's engine-cache check can
-        # compare against what the caller ASKED for
+        # the caller-requested value, so FFModel.generate's engine-cache
+        # check can compare against what the caller ASKED for
         self.requested_max_decode_len = self.max_decode_len
         self.max_queue = max_queue
         self.eos_id = eos_id
         # bitwise-vs-full-forward decode numerics (ServingState.exact) —
         # the verification mode; default is the fast matvec score path
         self.exact_decode = bool(exact_decode)
-        self._validate_graph()  # may clamp max_decode_len (position table)
+        # paged KV cache (ISSUE 12, docs/serving.md "Paged KV cache"):
+        # "paged" (default) = block pool + per-slot tables, "ring" = the
+        # legacy per-slot max_len buffers (the bitwise reference layout)
+        self.kv_cache = str(kv_cache or getattr(cfg, "kv_cache", "paged"))
+        self.kv_block_size = int(kv_block_size or
+                                 getattr(cfg, "kv_block_size", 16))
+        self.kv_dtype = str(kv_dtype or getattr(cfg, "kv_dtype", "native"))
+        kv_pool_blocks = int(kv_pool_blocks if kv_pool_blocks is not None
+                             else getattr(cfg, "kv_pool_blocks", 0))
+        if self.kv_cache not in ("paged", "ring"):
+            raise ValueError(
+                f"kv_cache must be 'paged' or 'ring', got "
+                f"{self.kv_cache!r}")
+        from .kvcache import KV_DTYPES, blocks_per_slot
+
+        if self.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{self.kv_dtype!r}")
+        if self.kv_cache == "ring" and self.kv_dtype != "native":
+            raise ValueError(
+                "kv_dtype='int8' requires the paged KV layout "
+                "(kv_cache='paged')")
+        # max supported context: bounded by the position-embedding table
+        # when it is shorter than the ring/pool capacity; admission
+        # REJECTS beyond it (the old warn-and-clamp is gone, ISSUE 12
+        # satellite)
+        self._validate_graph()
+        self.max_context = position_context_bound(self.executor,
+                                                  self.max_decode_len)
+        self.block_allocator = None
+        if self.kv_cache == "paged":
+            from .scheduler import BlockAllocator
+
+            mb = blocks_per_slot(self.max_decode_len, self.kv_block_size)
+            self.max_blocks_per_slot = mb
+            # auto pool: full capacity (every slot at max_len) + the
+            # garbage block — --kv-pool-blocks decouples occupancy from
+            # max_len (admission then waits on FREE BLOCKS, not slots)
+            self.kv_pool_blocks = kv_pool_blocks or (self.n_slots * mb + 1)
+            # ShardLint FF006 paged shape laws — statically, zero compile
+            from ..analysis import (AnalysisReport, StaticAnalysisError,
+                                    check_paged_kv)
+
+            diags = check_paged_kv(
+                self.executor.pcg,
+                block_size=self.kv_block_size,
+                pool_blocks=self.kv_pool_blocks,
+                max_blocks_per_slot=mb,
+                max_context=self.max_context)
+            if diags:
+                raise StaticAnalysisError(
+                    AnalysisReport(diagnostics=diags, checked=("FF006",)),
+                    context="paged KV configuration")
+            self.block_allocator = BlockAllocator(self.kv_pool_blocks,
+                                                  self.kv_block_size)
         self.buckets = tuple(buckets) if buckets else \
             default_buckets(self.max_decode_len)
         self.state: Optional[DecodeState] = None
         self._last_tokens = None  # (n_slots, 1) device int32
         self._write_slot_fn = None
+        self._clear_slot_fn = None
+        # filled by _ensure_state: which cache entries live in the block
+        # pool (vs slot-major) — the one pagedness classification
+        self._paged_entry_names: set = set()
         self._samplers: Dict = {}
         self.stats = ServingStats()
         self.plan = None  # ServingPlan from the last (re)search, if any
@@ -212,7 +322,6 @@ class ServingEngine:
                 f"serving needs a per-token final output (batch, seq, "
                 f"vocab); {final.name} produces {out} — pooled/classifier "
                 "heads cannot be decoded token by token")
-        pos_guids = set(self.executor._position_const_guids())
         for node in pcg.compute_nodes():
             ot = node.op.op_type
             if ot == OperatorType.OP_SDPA:
@@ -233,23 +342,12 @@ class ServingEngine:
                     raise ValueError(
                         f"{node.name}: serving decode supports "
                         "self-attention only (q, k, v from one producer)")
-            if ot == OperatorType.OP_EMBEDDING and any(
-                    g in pos_guids for g, _ in node.inputs):
-                # the position table bounds decodable length: positions
-                # beyond it would CLAMP under jit (jnp.take) and silently
-                # reuse the last row's embedding — clamp the ring LOUDLY
-                # to the table instead
-                entries = int(node.op.attrs.get("num_entries", 0))
-                if entries and entries < self.max_decode_len:
-                    import warnings
-
-                    warnings.warn(
-                        f"{node.name}: position table has {entries} "
-                        f"entries < max_decode_len {self.max_decode_len}; "
-                        f"clamping the decode ring to {entries} (build "
-                        "the model with a longer seq_len to serve longer "
-                        "sequences)")
-                    self.max_decode_len = entries
+            # NOTE: the position-table context bound lives in
+            # position_context_bound() — __init__ records it as
+            # self.max_context and scheduler.submit rejects any request
+            # whose prompt + max_new exceeds it (typed ServingRejection
+            # naming the max supported context; ISSUE 12 satellite
+            # replacing the old warn-and-clamp)
 
     def _token_input_check(self) -> None:
         ins = self.executor.pcg.input_nodes()
@@ -268,6 +366,10 @@ class ServingEngine:
         return self.model._obs_tracer()
 
     @property
+    def _paged(self) -> bool:
+        return self.kv_cache == "paged"
+
+    @property
     def decode_compiles(self) -> Optional[int]:
         """Entries in the decode step's jit cache — the recompile-free
         contract is exactly ``== 1`` after warmup (asserted in tier-1).
@@ -276,7 +378,8 @@ class ServingEngine:
         one-entry contract)."""
         fn = self.executor._serving_jits.get(
             ("decode", self.max_decode_len, self.exact_decode,
-             self._last_guard))
+             self._last_guard,
+             self.kv_block_size if self._paged else 0, self.kv_dtype))
         if fn is None:
             return None
         try:
@@ -286,50 +389,173 @@ class ServingEngine:
 
     # ------------------------------------------------------------ device fns
     def _decode_fn(self, guard: bool = False):
-        return self.executor.make_decode_step(self.max_decode_len,
-                                              exact=self.exact_decode,
-                                              guard=guard)
+        return self.executor.make_decode_step(
+            self.max_decode_len, exact=self.exact_decode, guard=guard,
+            block_size=self.kv_block_size if self._paged else 0,
+            kv_dtype=self.kv_dtype)
 
     def _prefill_fn(self, bucket: int):
         return self.executor.make_prefill_step(bucket, self.max_decode_len)
 
-    def _write_slot(self, cache, slot: int, length: int, token) -> None:
+    @staticmethod
+    def _is_kv_entry(entry) -> bool:
+        """Attention KV entries are (k, v) tuples of 4-D per-request ring
+        buffers ``(1, h, max_len, hd)`` — the pageable kind; everything
+        else (the LSTM carry ``(1, 2h)``) stays slot-major."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(entry)
+        return bool(leaves) and all(
+            getattr(leaf, "ndim", 0) == 4 for leaf in leaves)
+
+    def _write_slot(self, cache, slot: int, length: int, token,
+                    table_row=None) -> None:
         """Insert one prefilled request into the decode batch: cache rows,
         length cursor and the pending first token — one jitted scatter,
-        slot/length/token traced (no per-slot recompiles)."""
+        slot/length/token traced (no per-slot recompiles). Paged engines
+        additionally scatter the request's ring cache into its table
+        row's pool blocks (quantizing for int8 layouts) and set the
+        slot's block-table row — ``table_row`` is a traced int32 array,
+        so block choice never recompiles either."""
         import jax
         import jax.numpy as jnp
 
+        from .kvcache import scatter_prefill_paged
+
         if self._write_slot_fn is None:
-            def write(state, last, cache, slot, length, token):
-                caches = {
-                    name: update_slot_entry(state.caches[name],
-                                            cache[name], slot)
-                    for name in state.caches}
+            paged = self._paged
+            bs = self.kv_block_size
+            int8 = self.kv_dtype == "int8"
+            # the ONE pagedness decision: the entry-name set recorded by
+            # _ensure_state when it built the pool (a second structural
+            # classifier here could silently disagree for a future
+            # stateful op's cache shape)
+            kv_names = self._paged_entry_names if paged else set()
+
+            def write(state, last, cache, slot, length, token, table_row):
+                caches = {}
+                for name in state.caches:
+                    if paged and name in kv_names:
+                        if int8:
+                            kq, ks, vq, vs = state.caches[name]
+                            kc, vc = cache[name]
+                            kq, ks = scatter_prefill_paged(
+                                kq, kc, table_row, bs, scales=ks)
+                            vq, vs = scatter_prefill_paged(
+                                vq, vc, table_row, bs, scales=vs)
+                            caches[name] = (kq, ks, vq, vs)
+                        else:
+                            kp, vp = state.caches[name]
+                            kc, vc = cache[name]
+                            kp, _ = scatter_prefill_paged(kp, kc,
+                                                          table_row, bs)
+                            vp, _ = scatter_prefill_paged(vp, vc,
+                                                          table_row, bs)
+                            caches[name] = (kp, vp)
+                    else:
+                        caches[name] = update_slot_entry(
+                            state.caches[name], cache[name], slot)
                 lengths = state.lengths.at[slot].set(length)
+                tables = state.block_tables
+                if tables is not None:
+                    tables = tables.at[slot].set(table_row)
                 last = last.at[slot, 0].set(token)
-                return DecodeState(caches=caches, lengths=lengths), last
+                return DecodeState(caches=caches, lengths=lengths,
+                                   block_tables=tables), last
 
             self._write_slot_fn = jax.jit(write, donate_argnums=(0, 1))
+        if table_row is None:
+            table_row = np.zeros(
+                (getattr(self, "max_blocks_per_slot", 1),), np.int32)
         self.state, self._last_tokens = self._write_slot_fn(
             self.state, self._last_tokens, cache,
-            jnp.int32(slot), jnp.int32(length), jnp.int32(token))
+            jnp.int32(slot), jnp.int32(length), jnp.int32(token),
+            jnp.asarray(table_row, jnp.int32))
+
+    def _clear_slot_tables(self, slot: int) -> None:
+        """Reset a freed slot's device-side block-table row (all GARBAGE)
+        and length cursor (0). Fired by the scheduler on EVERY
+        slot-freeing path: without it the freed slot's stale row keeps
+        scattering its discarded per-step tokens into blocks the
+        allocator may already have handed to a NEW request in a
+        different slot — KV corruption with no error (the garbage-block
+        safety argument only covers never-admitted slots). One tiny
+        donated jit; slot traced, so recycling never recompiles."""
+        import jax
+        import jax.numpy as jnp
+
+        from .resilience import state_buffers_lost
+
+        if self.state is None or self.state.block_tables is None or \
+                state_buffers_lost(self.state):
+            return  # no pool (or a dead one about to be rebuilt)
+        if self._clear_slot_fn is None:
+            def clear(state, slot):
+                return DecodeState(
+                    caches=state.caches,
+                    lengths=state.lengths.at[slot].set(0),
+                    block_tables=state.block_tables.at[slot].set(0))
+
+            self._clear_slot_fn = jax.jit(clear, donate_argnums=(0,))
+        self.state = self._clear_slot_fn(self.state, jnp.int32(slot))
+
+    def _table_row_for(self, req) -> np.ndarray:
+        """The (max_blocks_per_slot,) int32 block-table row for an
+        admitted request: its allocated blocks, GARBAGE_BLOCK beyond."""
+        row = np.zeros((self.max_blocks_per_slot,), np.int32)
+        if req.kv_blocks:
+            row[:len(req.kv_blocks)] = req.kv_blocks
+        return row
 
     def _ensure_state(self, prefill_cache) -> None:
         """Allocate the slot-pool DecodeState lazily from the first
         prefill's cache structure (zeros; every slot's rows are fully
-        overwritten by its admission prefill before any read)."""
+        overwritten by its admission prefill before any read). Paged
+        engines build the block POOL per KV entry — ``(kv_pool_blocks,
+        h, block_size, hd)`` (+ f32 scale arrays for int8) — instead of
+        per-slot rings, plus the all-garbage block tables."""
         import jax
         import jax.numpy as jnp
+
+        from .kvcache import paged_pool_entry
 
         if self.state is not None:
             return
         n = self.n_slots
-        caches = jax.tree.map(
-            lambda leaf: jnp.zeros((n,) + leaf.shape[1:], leaf.dtype),
-            prefill_cache)
+        tables = None
+        if self._paged:
+            caches = {}
+            self._paged_entry_names = set()
+            for name, entry in prefill_cache.items():
+                if self._is_kv_entry(entry):
+                    self._paged_entry_names.add(name)
+                    kc, vc = entry
+                    if self.kv_dtype == "int8":
+                        kq, ks = paged_pool_entry(
+                            kc, self.kv_pool_blocks, self.kv_block_size,
+                            "int8")
+                        vq, vs = paged_pool_entry(
+                            vc, self.kv_pool_blocks, self.kv_block_size,
+                            "int8")
+                        caches[name] = (kq, ks, vq, vs)
+                    else:
+                        caches[name] = (
+                            paged_pool_entry(kc, self.kv_pool_blocks,
+                                             self.kv_block_size, "native"),
+                            paged_pool_entry(vc, self.kv_pool_blocks,
+                                             self.kv_block_size, "native"))
+                else:
+                    caches[name] = jax.tree.map(
+                        lambda leaf: jnp.zeros((n,) + leaf.shape[1:],
+                                               leaf.dtype), entry)
+            tables = jnp.zeros((n, self.max_blocks_per_slot), jnp.int32)
+        else:
+            caches = jax.tree.map(
+                lambda leaf: jnp.zeros((n,) + leaf.shape[1:], leaf.dtype),
+                prefill_cache)
         self.state = DecodeState(caches=caches,
-                                 lengths=jnp.zeros((n,), jnp.int32))
+                                 lengths=jnp.zeros((n,), jnp.int32),
+                                 block_tables=tables)
         self._last_tokens = jnp.zeros((n, 1), jnp.int32)
 
     def _sampler(self, temperature: float, top_k: int):
@@ -393,6 +619,19 @@ class ServingEngine:
                                  controller=self.admission,
                                  clock=self.resilience_clock)
 
+    def _attach_kv_accounting(self, sched: ContinuousBatchScheduler
+                              ) -> None:
+        """Bind the engine's paged-KV bookkeeping to a scheduler: the
+        block allocator (admission allocates, recycling frees) and the
+        max supported context (admission rejects beyond the position
+        table, ISSUE 12 satellite). Idempotent; a ring engine only sets
+        the context bound when the table is the binding constraint."""
+        if self.block_allocator is not None:
+            sched.allocator = self.block_allocator
+            sched.on_slot_freed = self._clear_slot_tables
+        if self.max_context < sched.max_len:
+            sched.max_context = self.max_context
+
     def admit(self, sched: ContinuousBatchScheduler, req: Request,
               resilience=None) -> None:
         """Resilient admission (ISSUE 9): deadline stamp + shed-policy
@@ -402,6 +641,7 @@ class ServingEngine:
         ``resilience``, events accumulate on a pending policy object the
         next ``serve()`` consumes — a pre-serve shed or deadline stamp is
         never lost to a throwaway."""
+        self._attach_kv_accounting(sched)
         res = resilience
         if res is None:
             if self._pending_resilience is None:
@@ -432,6 +672,7 @@ class ServingEngine:
             buckets=self.buckets, max_len=self.max_decode_len,
             clock=res.clock)
         sched.shed_policy = res.shed_policy
+        self._attach_kv_accounting(sched)
         reqs = []
         for i, p in enumerate(prompts):
             r = Request(prompt=np.asarray(p, dtype=np.int32),
@@ -534,9 +775,53 @@ class ServingEngine:
         """Drop the slot-pool DecodeState (replica kill / rejoin in the
         fleet): the next admission prefill rebuilds it from scratch via
         ``_ensure_state`` — committed tokens live host-side on each
-        Request, so nothing user-visible is lost."""
+        Request, so nothing user-visible is lost. Paged engines also
+        reset the block allocator (no block of the discarded pool is
+        live anymore; survivors' re-prefills allocate fresh tables)."""
         self.state = None
         self._last_tokens = None
+        if self.block_allocator is not None:
+            self.block_allocator.reset()
+
+    # ------------------------------------------------------ KV accounting
+    def _kv_row_bytes(self) -> int:
+        """Analytic KV bytes ONE token's row costs across every attention
+        node — heads * (kdim + vdim) * element size (int8 layouts add the
+        two f32 per-(token, head) scales). The decode bytes-read/token
+        bench column and the admission-honesty math both price from
+        this."""
+        if getattr(self, "_kv_row_bytes_cache", None) is None:
+            from ..ffconst import size_of_datatype
+            from .kvcache import kv_token_bytes
+
+            total = 0
+            for node in self.executor.pcg.compute_nodes():
+                if node.op.op_type != OperatorType.OP_MULTIHEAD_ATTENTION:
+                    continue
+                a = node.op.attrs
+                heads = int(a.get("num_heads", 1))
+                kd = int(a.get("kdim") or a["embed_dim"] // heads)
+                vd = int(a.get("vdim") or a["embed_dim"] // heads)
+                total += kv_token_bytes(
+                    heads, kd, vd, size_of_datatype(node.op.data_type),
+                    self.kv_dtype)
+            self._kv_row_bytes_cache = total
+        return self._kv_row_bytes_cache
+
+    def _decode_kv_bytes(self, live) -> int:
+        """Analytic KV bytes this decode step's attention reads: paged —
+        each live slot's OCCUPIED blocks (the flash-decode kernel's
+        actual traffic, O(true_length)); ring — every slot's full
+        ``max_len`` ring (the O(max_len) bill paged decode removes)."""
+        row = self._kv_row_bytes()
+        if not self._paged:
+            return self.n_slots * self.max_decode_len * row
+        bs = self.kv_block_size
+        toks = 0
+        for _slot, req in live:
+            keys = req.effective_len + 1
+            toks += -(-keys // bs) * bs
+        return toks * row
 
     def _sweep_deadlines(self, sched, res, tracer) -> None:
         """Deadline enforcement at the iteration boundary: expired queued
@@ -747,6 +1032,7 @@ class _ServeLoop:
             res.chaos = chaos
         self.chaos = res.chaos
         sched.shed_policy = res.shed_policy
+        eng._attach_kv_accounting(sched)
         # ONE time base: submit stamps were taken with the scheduler's
         # clock, so every sweep/drain decision reads the same clock — a
         # mismatched engine.resilience_clock on a caller-built scheduler
@@ -856,7 +1142,9 @@ class _ServeLoop:
                 tracer.complete("prefill", wall, rid=req.rid,
                                 bucket=bucket, slot=slot, prompt_len=eff)
             if not sched.commit_token(slot, tok):
-                eng._write_slot(cache, slot, eff, tok)
+                eng._write_slot(cache, slot, eff, tok,
+                                table_row=(eng._table_row_for(req)
+                                           if eng._paged else None))
             return True
         # decode: one token for every live slot. Sampling covers ALL
         # slots (free ones with a dummy rng, their draws discarded) so
@@ -894,7 +1182,11 @@ class _ServeLoop:
             # (rng streams key on (tag, tokens_emitted) — continuations
             # are unchanged). A stream whose committed length outgrew
             # the prefill buckets cannot re-enter and is evicted
-            # (preempted).
+            # (preempted). Drop the dead state FIRST: the quarantine
+            # path's on_slot_freed hook must see an empty pool, not
+            # deleted buffers
+            eng.state = None
+            eng._last_tokens = None
             for slot, req in live:
                 try:
                     bucket_for(req.effective_len, sched.buckets)
@@ -902,8 +1194,6 @@ class _ServeLoop:
                     sched.evict(slot, "preempted")
                     continue
                 sched.quarantine(slot)
-            eng.state = None
-            eng._last_tokens = None
             if tracer.enabled:
                 tracer.event("serving_state_rebuild", step=k,
                              requeued=len(live))
@@ -931,6 +1221,7 @@ class _ServeLoop:
         wall = time.perf_counter() - t_d
         stats.decode_steps += 1
         self.step_no += 1
+        stats.kv_bytes_read += eng._decode_kv_bytes(live)
         if self.res_active:
             res.controller.observe_step(wall, len(live))
         for slot, req in live:

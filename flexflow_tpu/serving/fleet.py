@@ -672,10 +672,14 @@ class ServingFleet:
             prior_submit = req.submit_ms
             try:
                 rep.sched.submit(req)
-            except ValueError:
+            except (ValueError, ServingRejection):
                 # a migrated stream whose prompt+committed tokens no
                 # bucket covers can re-enter nowhere: preempted, exactly
-                # once (the caller keeps the partial continuation)
+                # once (the caller keeps the partial continuation).
+                # ServingRejection covers the ISSUE 12 max-context bound
+                # (ContextOverflowError) — every replica shares the
+                # model's position table, so no other replica can take
+                # it either; one request must never crash the fleet
                 req.outcome = "preempted"
                 req.done = True
                 continue

@@ -22,13 +22,45 @@ prompt at position 0, each decode step writes ONE token at
 ``lengths[slot]`` via a per-slot dynamic_update_slice, and attention masks
 key positions ``> position``. Pad garbage beyond a prompt's true length is
 never read: the write cursor overwrites it before the mask ever exposes it.
+
+Paged layout (ISSUE 12, vLLM-style PagedAttention adapted to JAX/TPU):
+the per-slot ``max_len`` ring buffers become ONE pool of fixed-size KV
+blocks per stateful node — ``(n_blocks, heads, block_size, head_dim)`` —
+plus a per-slot **block table** ``(n_slots, max_blocks_per_slot)`` int32
+mapping each slot's logical positions onto pool blocks. Slot recycling
+and (future) prefix sharing are pointer bookkeeping in the host-side
+:class:`~flexflow_tpu.serving.scheduler.BlockAllocator`; pool occupancy
+decouples from ``max_len`` (a short request holds few blocks); and the
+single-compile decode contract survives — block tables are just another
+int32 array in the jitted signature. Block index 0 is the reserved
+GARBAGE block: every unused table entry points at it, free slots write
+their (discarded) tokens into it, and the attention mask guarantees it
+is never read — so its contents only ever need to stay FINITE (``0 *
+garbage`` must be exactly ``0.0`` for the paged/ring bitwise-equality
+contract; the chaos poisoner deliberately never NaNs it).
+
+Quantized layout (``kv_dtype="int8"``): pool blocks store symmetric
+per-(token, head) int8 rows with float32 scales in block-paged scale
+arrays ``(n_blocks, heads, block_size)`` — scale = amax/127 over the
+head_dim row, written once with the row and folded back on read. The
+exact-decode bitwise contract applies to fp layouts only; int8 is judged
+against a pinned tolerance band (tests/test_decode_paged.py).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+#: reserved pool block every unused block-table entry points at — written
+#: by free slots, never read (masked), must stay finite
+GARBAGE_BLOCK = 0
+
+#: supported KV-cache storage dtypes (the searched serving axis)
+KV_DTYPES = ("native", "int8")
+
+INT8_QMAX = 127.0
 
 
 @dataclasses.dataclass
@@ -53,6 +85,13 @@ class ServingState:
                as a matvec whose d-axis accumulation order differs from
                the GEMM's by ~1 ulp otherwise. Default False (the fast
                matvec); the equivalence tests and audits flip it on.
+    block_tables: (n_slots, max_blocks_per_slot) int32 — the paged-KV
+               block tables (None selects the legacy ring layout; the
+               branch is static at trace time, so ring and paged decode
+               are distinct compiles, each recompile-free)
+    block_size: tokens per KV block (paged layout only)
+    kv_dtype:  "native" (store k/v at the model dtype) or "int8"
+               (symmetric per-(token, head) quantization with f32 scales)
     """
 
     mode: str
@@ -62,31 +101,49 @@ class ServingState:
     cache_in: Optional[Dict[str, Any]] = None
     cache_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
     exact: bool = False
+    block_tables: Any = None
+    block_size: int = 0
+    kv_dtype: str = "native"
+
+    @property
+    def paged(self) -> bool:
+        return self.block_tables is not None
 
 
 @dataclasses.dataclass
 class DecodeState:
     """The decode loop's carried state: {node_name: cache pytree} plus the
     per-slot length cursor. A pytree node — ``jax.jit`` donates and returns
-    it whole, so the ring buffers update in place on device (the decode
-    loop never copies the cache host-side)."""
+    it whole, so the ring buffers (or the paged pool) update in place on
+    device (the decode loop never copies the cache host-side).
+
+    ``block_tables`` is None for the ring layout; for the paged layout it
+    is the (n_slots, max_blocks_per_slot) int32 table mapping each slot's
+    positions onto pool blocks — it only changes at admission (the slot
+    writer sets the row), so decode steps carry it through untouched."""
 
     caches: Dict[str, Any]
     lengths: Any  # (n_slots,) int32
+    block_tables: Any = None  # (n_slots, max_blocks_per_slot) int32 | None
 
     @property
     def n_slots(self) -> int:
         return int(self.lengths.shape[0])
 
+    @property
+    def paged(self) -> bool:
+        return self.block_tables is not None
+
 
 def _decode_state_flatten(s: "DecodeState"):
     names = tuple(sorted(s.caches))
-    return ([s.caches[k] for k in names] + [s.lengths]), names
+    return ([s.caches[k] for k in names]
+            + [s.lengths, s.block_tables]), names
 
 
 def _decode_state_unflatten(names, children):
-    return DecodeState(caches=dict(zip(names, children[:-1])),
-                       lengths=children[-1])
+    return DecodeState(caches=dict(zip(names, children[:-2])),
+                       lengths=children[-2], block_tables=children[-1])
 
 
 def _register_pytree() -> None:
@@ -142,3 +199,138 @@ def write_token_kv(buf, new, positions):
         return lax.dynamic_update_slice(dst, src, (0, p, 0))
 
     return jax.vmap(one)(buf, new, positions)
+
+
+# ----------------------------------------------------------- paged layout
+def blocks_per_slot(max_len: int, block_size: int) -> int:
+    """Block-table width: blocks covering ``max_len`` tokens."""
+    return -(-int(max_len) // int(block_size))
+
+
+def kv_token_bytes(heads: int, kdim: int, vdim: int, el: int,
+                   kv_dtype: str = "native") -> int:
+    """KV bytes ONE token costs across one attention node's heads — THE
+    shared pricing formula behind the engine's measured
+    ``kv_bytes_read`` accounting AND the serving search's explicit
+    KV-stream term (``_attention_state_bytes``): int8 stores 1-byte
+    rows plus the two f32 per-(token, head) scales; native stores the
+    model dtype. One implementation, two consumers — the bench's
+    measured fill ratio is fed back into ``serving_search(kv_fill=)``,
+    so the two sides must never price from drifting copies."""
+    if kv_dtype == "int8":
+        return heads * ((kdim + vdim) * 1 + 8)
+    return heads * (kdim + vdim) * el
+
+
+def quantize_kv(x) -> Tuple[Any, Any]:
+    """Symmetric per-(..., token, head)-row int8 quantization over the
+    trailing head_dim axis: ``q = round(x / scale)`` with
+    ``scale = amax(|x|) / 127`` (scale 1 for all-zero rows — dequant of a
+    zero row stays exactly zero). Returns ``(q int8, scale f32)`` with
+    ``scale`` shaped like ``x`` minus its last axis."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """Fold the per-row scale back: ``q * scale`` in f32, cast to the
+    compute dtype — the read half of :func:`quantize_kv`."""
+    import jax.numpy as jnp
+
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def write_token_kv_paged(pool, new, positions, block_tables, block_size):
+    """Scatter one token's k or v (n_slots, h, 1, hd) into the block pool
+    (n_blocks, h, block_size, hd) at each slot's current position: block
+    ``tables[slot, pos // bs]``, offset ``pos % bs``. Free slots (their
+    table rows all GARBAGE_BLOCK, position 0) collide harmlessly in the
+    garbage block — it is never read. No arithmetic on stored values."""
+    import jax.numpy as jnp
+
+    bi = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    off = positions % block_size
+    return pool.at[bi, :, off].set(new[:, :, 0, :].astype(pool.dtype))
+
+
+def write_token_scale_paged(scales, scale_new, positions, block_tables,
+                            block_size):
+    """Scale-array twin of :func:`write_token_kv_paged`:
+    ``scales (n_blocks, h, block_size)``, ``scale_new (n_slots, h, 1)``."""
+    import jax.numpy as jnp
+
+    bi = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    off = positions % block_size
+    return scales.at[bi, :, off].set(scale_new[:, :, 0])
+
+
+def gather_paged_kv(pool, block_tables):
+    """Materialize each slot's logical KV extent from the pool:
+    ``(n_blocks, h, bs, hd)`` gathered through ``(n_slots, mb)`` tables →
+    ``(n_slots, h, mb * bs, hd)`` in position order. This is the
+    CPU/exact fallback read (O(mb * bs) rows like the ring layout — the
+    Pallas flash-decode kernel is the O(true_length) path); a pure
+    gather, so the materialized rows are bitwise the stored rows."""
+    import jax.numpy as jnp
+
+    g = pool[block_tables]                 # (S, mb, h, bs, hd)
+    g = jnp.swapaxes(g, 1, 2)              # (S, h, mb, bs, hd)
+    return g.reshape(g.shape[0], g.shape[1], -1, g.shape[-1])
+
+
+def gather_paged_scales(scales, block_tables):
+    """(n_blocks, h, bs) through (n_slots, mb) → (n_slots, h, mb * bs)."""
+    import jax.numpy as jnp
+
+    g = scales[block_tables]               # (S, mb, h, bs)
+    g = jnp.swapaxes(g, 1, 2)              # (S, h, mb, bs)
+    return g.reshape(g.shape[0], g.shape[1], -1)
+
+
+def paged_pool_entry(ring_leaf, n_blocks: int, block_size: int,
+                     kv_dtype: str):
+    """Zeros-initialized pool (+ scales for int8) for one KV leaf whose
+    per-request ring shape is ``(1, h, max_len, hd)``. Returns the pool
+    array for "native", ``(pool int8, scales f32)`` for "int8"."""
+    import jax.numpy as jnp
+
+    _, h, _L, hd = ring_leaf.shape
+    if kv_dtype == "int8":
+        return (jnp.zeros((n_blocks, h, block_size, hd), jnp.int8),
+                jnp.zeros((n_blocks, h, block_size), jnp.float32))
+    return jnp.zeros((n_blocks, h, block_size, hd), ring_leaf.dtype)
+
+
+def scatter_prefill_paged(pool, ring_leaf, table_row, block_size: int,
+                          scales=None):
+    """Insert one prefilled request's ring cache ``(1, h, max_len, hd)``
+    into its table row's pool blocks: the ring is padded to whole blocks,
+    reshaped block-major and scattered at ``table_row`` (mb,) int32.
+    Unused table entries point at GARBAGE_BLOCK and receive the ring's
+    zero pad — harmless, never read. For int8 pools the rows are
+    quantized here (``scales`` must be the matching scale array); fp
+    pools store the rows bit-unchanged."""
+    import jax.numpy as jnp
+
+    x = ring_leaf[0]                       # (h, L, hd)
+    h, L, hd = x.shape
+    mb = int(table_row.shape[0])
+    pad = mb * block_size - L
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    if scales is not None:
+        q, s = quantize_kv(x)              # (h, P, hd), (h, P)
+        qb = q.reshape(h, mb, block_size, hd).transpose(1, 0, 2, 3)
+        sb = s.reshape(h, mb, block_size).transpose(1, 0, 2)
+        return (pool.at[table_row].set(qb),
+                scales.at[table_row].set(sb))
+    xb = x.reshape(h, mb, block_size, hd).transpose(1, 0, 2, 3)
+    return pool.at[table_row].set(xb.astype(pool.dtype)), None

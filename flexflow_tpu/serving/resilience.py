@@ -55,7 +55,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from .scheduler import (ContinuousBatchScheduler, QueueFullError, Request,
+from .scheduler import (ContinuousBatchScheduler, Request,
                         ServingRejection, now_ms)
 
 #: terminal request dispositions — every request that enters the system
@@ -156,6 +156,27 @@ class AdmissionController:
         self._ewma_token_ms: Optional[float] = None
         self.observed_steps = 0
         self.force_token_cost_ms: Optional[float] = None
+        # speculative decoding (ISSUE 12, serving/speculative.py): the
+        # per-token cost EWMA already absorbs speculation honestly —
+        # verification rounds report (wall, tokens COMMITTED) through
+        # observe_step — this additionally tracks the acceptance-rate
+        # EWMA for introspection/telemetry (None until speculation runs)
+        self.spec_acceptance: Optional[float] = None
+
+    def observe_speculation(self, accepted: int, proposed: int) -> None:
+        """Feed one verification round's (accepted, proposed) draft
+        counts; keeps a same-alpha EWMA of the acceptance rate. The COST
+        side of speculation needs no special casing — callers report
+        committed tokens per round wall via :meth:`observe_step`, so the
+        per-token EWMA reprices itself."""
+        if proposed <= 0:
+            return
+        rate = accepted / proposed
+        if self.spec_acceptance is None:
+            self.spec_acceptance = rate
+        else:
+            self.spec_acceptance += self.alpha * (rate -
+                                                  self.spec_acceptance)
 
     @property
     def token_cost_ms(self) -> float:
@@ -299,9 +320,10 @@ class ServingResilience:
                     retry_after_ms=self.controller.retry_after_ms(sched))
         try:
             sched.submit(req)
-        except QueueFullError:
-            # the hard wall sheds too (policy 'off' has no earlier gate):
-            # the rejection still lands in the ledger under exactly one
+        except ServingRejection:
+            # the hard walls shed too (policy 'off' has no earlier gate;
+            # ISSUE 12 adds the max-context ContextOverflowError): the
+            # rejection still lands in the ledger under exactly one
             # outcome instead of vanishing from the accounting
             self.sheds += 1
             req.outcome = "shed"

@@ -76,6 +76,68 @@ class QueueFullError(ServingRejection):
     backpressure signal, not an internal failure."""
 
 
+class ContextOverflowError(ServingRejection):
+    """Admission refused: the request's worst case (prompt + max new
+    tokens) exceeds the engine's max supported context — the position
+    embedding table bounds decodable length below the decode ring/pool
+    capacity (ISSUE 12 satellite: previously the engine warned and
+    clamped the ring at construction; rejecting AT ADMISSION, naming the
+    limit, is what guarantees a too-long request can never silently alias
+    position rows)."""
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the paged KV pool (ISSUE 12).
+
+    The pool is ``n_blocks`` fixed-size blocks of ``block_size`` tokens;
+    block ``GARBAGE_BLOCK`` (0) is reserved — unused table entries point
+    at it — so ``n_blocks - 1`` blocks are allocatable. Allocation is
+    whole-request up front (``blocks_needed(prompt + max_new)``) at the
+    moment a request is admitted into a slot, so the decode hot loop
+    never allocates; recycling (EOS/length/eviction/quarantine/
+    cancellation) returns the blocks through the scheduler's one
+    ``_release_blocks`` choke point. Pure host bookkeeping — deterministic
+    FIFO free list, so the schedule stays a function of the submission
+    sequence."""
+
+    def __init__(self, n_blocks: int, block_size: int):
+        assert n_blocks >= 2, "paged pool needs >= 1 usable block " \
+                              "+ the garbage block"
+        assert block_size >= 1
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.free_blocks: Deque[int] = deque(range(1, self.n_blocks))
+        self.blocks_hwm = 0
+
+    @property
+    def n_usable(self) -> int:
+        return self.n_blocks - 1
+
+    @property
+    def in_use(self) -> int:
+        return self.n_usable - len(self.free_blocks)
+
+    def blocks_needed(self, tokens: int) -> int:
+        return -(-max(int(tokens), 1) // self.block_size)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None when the pool cannot satisfy the
+        request right now (the scheduler keeps it queued and decodes)."""
+        if n > len(self.free_blocks):
+            return None
+        out = [self.free_blocks.popleft() for _ in range(int(n))]
+        self.blocks_hwm = max(self.blocks_hwm, self.in_use)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        self.free_blocks.extend(blocks)
+
+    def reset(self) -> None:
+        """Forget every allocation (replica kill/rejoin: the pool arrays
+        are rebuilt from zeros, so no block is live anymore)."""
+        self.free_blocks = deque(range(1, self.n_blocks))
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request. ``prompt`` is a 1-D int token array;
@@ -107,6 +169,10 @@ class Request:
     submit_ms: float = 0.0
     outcome: Optional[str] = None
     retries_used: int = 0
+    # paged KV (ISSUE 12): pool block ids this request holds while it
+    # occupies a slot (allocated at admission, freed on recycle) — empty
+    # for ring-layout engines and while queued
+    kv_blocks: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def prompt_len(self) -> int:
@@ -203,6 +269,18 @@ class ContinuousBatchScheduler:
         self.draining = False
         self.quarantined = 0
         self.evicted = 0
+        # paged KV (ISSUE 12): the engine attaches its BlockAllocator and
+        # max supported context (position-table bound) before driving the
+        # loop; None = ring layout / no context bound below max_len.
+        # on_slot_freed fires on EVERY slot-freeing path (finish, evict,
+        # quarantine, hedge cancel) — the paged engine resets the freed
+        # slot's device-side block-table row and length cursor there: a
+        # stale row would keep scattering the freed slot's discarded
+        # tokens into blocks the allocator may have already handed to a
+        # NEW request in another slot
+        self.allocator: Optional[BlockAllocator] = None
+        self.max_context: Optional[int] = None
+        self.on_slot_freed = None
         # hedge-loss cancellations (ISSUE 11): slots/queue entries freed
         # WITHOUT a terminal outcome — the winning twin owns the ledger
         self.cancelled = 0
@@ -229,6 +307,29 @@ class ContinuousBatchScheduler:
                 f"request {req.rid}: prompt {req.prompt_len} + "
                 f"max_new_tokens {req.max_new_tokens} exceeds the decode "
                 f"ring capacity {self.max_len} (--max-decode-len)")
+        # max supported context (ISSUE 12 satellite): the position table
+        # bounds decodable length below the ring/pool capacity — reject
+        # at admission, naming the limit, instead of the old
+        # warn-and-clamp at engine construction
+        if self.max_context is not None and \
+                req.prompt_len + req.max_new_tokens > self.max_context:
+            raise ContextOverflowError(
+                f"request {req.rid}: prompt {req.prompt_len} + "
+                f"max_new_tokens {req.max_new_tokens} exceeds the max "
+                f"supported context {self.max_context} (position "
+                "embedding table limit; build the model with a longer "
+                "seq_len or lower max_new_tokens)",
+                queued=len(self.queue), active=self.active)
+        # a request the whole pool cannot hold would deadlock admission —
+        # refuse it at submit, like the ring-capacity wall above
+        if self.allocator is not None:
+            need = self.allocator.blocks_needed(
+                req.prompt_len + req.max_new_tokens)
+            if need > self.allocator.n_usable:
+                raise ValueError(
+                    f"request {req.rid}: needs {need} KV blocks but the "
+                    f"pool has {self.allocator.n_usable} (raise "
+                    "--kv-pool-blocks or --kv-block-size)")
         # fail HERE, not after next_action() already claimed a slot: a
         # prompt no bucket covers must never corrupt the slot pool.
         # effective_len (prompt + committed tokens) is what the prefill
@@ -249,12 +350,27 @@ class ContinuousBatchScheduler:
         only decode actions are produced, so in-flight requests finish and
         the queue is left intact for the engine to hand back."""
         if self.queue and self._free and not self.draining:
-            req = self.queue.popleft()
-            slot = self._free.popleft()
-            self.slots[slot] = req
-            self.admitted += 1
-            return ("prefill", req, slot,
-                    bucket_for(req.effective_len, self.buckets))
+            req = self.queue[0]
+            blocks = None
+            if self.allocator is not None:
+                # whole-request up-front allocation: the slot's blocks
+                # cover prompt + max_new, so the decode loop never
+                # allocates. FIFO is preserved — when the HEAD request
+                # cannot get its blocks yet, admission waits (decode
+                # continues; recycling will free blocks)
+                blocks = self.allocator.alloc(self.allocator.blocks_needed(
+                    req.prompt_len + req.max_new_tokens))
+                if blocks is None:
+                    req = None
+            if req is not None:
+                self.queue.popleft()
+                if blocks is not None:
+                    req.kv_blocks = blocks
+                slot = self._free.popleft()
+                self.slots[slot] = req
+                self.admitted += 1
+                return ("prefill", req, slot,
+                        bucket_for(req.effective_len, self.buckets))
         live = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if live:
             return ("decode", live)
@@ -273,16 +389,28 @@ class ContinuousBatchScheduler:
             return self._finish(slot, "length")
         return False
 
+    def _release_blocks(self, req: Request) -> None:
+        """The ONE choke point returning a request's pool blocks to the
+        allocator — every slot-freeing path (finish, evict, quarantine,
+        hedge cancellation) funnels through it so a block can never leak
+        or double-free."""
+        if self.allocator is not None and req.kv_blocks:
+            self.allocator.free(req.kv_blocks)
+        req.kv_blocks = []
+
     def _finish(self, slot: int, reason: str,
                 outcome: str = "ok") -> bool:
         req = self.slots[slot]
         req.done = True
         req.finish_reason = reason
         req.outcome = outcome
+        self._release_blocks(req)
         self.finished.append(req)
         self.slots[slot] = None
         self._free.append(slot)
         self.recycled += 1
+        if self.on_slot_freed is not None:
+            self.on_slot_freed(slot)
         return True
 
     # ---------------------------------------------------------- resilience
@@ -310,6 +438,7 @@ class ContinuousBatchScheduler:
         req.done = True
         req.finish_reason = outcome
         req.outcome = outcome
+        self._release_blocks(req)  # defensive: queued requests hold none
         self.finished.append(req)
 
     def quarantine(self, slot: int) -> Request:
@@ -321,10 +450,13 @@ class ContinuousBatchScheduler:
         tokens (``current_prompt`` re-prefills prompt + generated)."""
         req = self.slots[slot]
         assert req is not None, f"quarantine of empty slot {slot}"
+        self._release_blocks(req)  # the retry re-allocates at re-admission
         self.slots[slot] = None
         self._free.append(slot)
         self.quarantined += 1
         self.queue.appendleft(req)
+        if self.on_slot_freed is not None:
+            self.on_slot_freed(slot)
         return req
 
     def cancel_slot(self, slot: int) -> Request:
@@ -337,9 +469,12 @@ class ContinuousBatchScheduler:
         invariant)."""
         req = self.slots[slot]
         assert req is not None, f"cancel of empty slot {slot}"
+        self._release_blocks(req)
         self.slots[slot] = None
         self._free.append(slot)
         self.cancelled += 1
+        if self.on_slot_freed is not None:
+            self.on_slot_freed(slot)
         return req
 
     def cancel_queued(self, req: Request) -> None:

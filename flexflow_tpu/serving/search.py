@@ -57,11 +57,15 @@ class ServingSearchError(RuntimeError):
 
 @dataclasses.dataclass
 class ServingCandidate:
-    """One priced (mesh, layout) point of the serving sweep."""
+    """One priced (mesh, layout, kv_dtype) point of the serving sweep."""
 
     mesh_shape: Tuple[int, int]
     layout: str  # "sharded" | "replicated" (KV-cache over the model axis?)
     slots_per_replica: int
+    # KV storage dtype (ISSUE 12): "native" or "int8" — int8 streams
+    # ~1/el of the KV bytes (+ f32 scales) per decode step, the
+    # precision-for-bandwidth trade the latency-bounded objective prices
+    kv_dtype: str = "native"
     sim_decode_ms: float = 0.0
     sim_prefill_ms: float = 0.0
     sim_p50_ms: float = 0.0
@@ -72,6 +76,7 @@ class ServingCandidate:
 
     def describe(self) -> str:
         return (f"mesh={tuple(self.mesh_shape)} kv={self.layout} "
+                f"kv_dtype={self.kv_dtype} "
                 f"slots/replica={self.slots_per_replica}")
 
 
@@ -93,12 +98,14 @@ class ServingPlan:
     sim_tokens_per_s: float
     sim_memory: int
     feasible: bool
+    kv_dtype: str = "native"
     assignment: Dict[int, object] = dataclasses.field(default_factory=dict)
     ranked: List[ServingCandidate] = dataclasses.field(default_factory=list)
     sim: object = None  # the warm Simulator (elastic re-search reuse)
 
     def describe(self) -> str:
         return (f"mesh={tuple(self.mesh_shape)} kv={self.layout} "
+                f"kv_dtype={self.kv_dtype} "
                 f"tokens/s={self.sim_tokens_per_s:.1f} "
                 f"p99={self.sim_p99_ms:.2f}ms")
 
@@ -211,21 +218,33 @@ def _pick_kind(node: PCGNode, tp: int,
     return "none"
 
 
-def _attention_state_bytes(node: PCGNode, slots: int, max_len: int) -> int:
+def _attention_state_bytes(node: PCGNode, slots: int, max_len: int,
+                           kv_dtype: str = "native") -> int:
+    from .kvcache import kv_token_bytes
+
     a = node.op.attrs
     heads = int(a.get("num_heads", 1))
     kdim = int(a.get("kdim") or a["embed_dim"] // heads)
     vdim = int(a.get("vdim") or a["embed_dim"] // heads)
-    el = size_of_datatype(node.op.data_type)
-    return slots * heads * max_len * (kdim + vdim) * el
+    return slots * max_len * kv_token_bytes(
+        heads, kdim, vdim, size_of_datatype(node.op.data_type), kv_dtype)
 
 
 def _graph_cost(sim, g: PCG, tp: int, kv_div: int, slots: int,
-                max_len: int, decode: bool):
+                max_len: int, decode: bool, kv_dtype: str = "native",
+                kv_fill: float = 1.0):
     """(step_time_s, per_chip_mem_bytes, assignment) for one re-inferred
     serving graph under degree-``tp`` model parallelism. Forward-only:
     comm is half the op_cost fwd+bwd figure, sync/update dropped, no
-    optimizer state in the memory model."""
+    optimizer state in the memory model.
+
+    ``kv_dtype`` selects the KV-stream element size (ISSUE 12: int8
+    streams ~1/el the bytes plus f32 scales); ``kv_fill`` scales the
+    per-step KV READ traffic (1.0 = the ring layout's O(max_len) bill;
+    the paged flash-decode path reads only occupied blocks, so a
+    measured mean-occupancy fill prices its true traffic). Pool
+    CAPACITY is always charged at full extent — feasibility must hold
+    at worst case."""
     from ..search.simulator import OpSharding
 
     t = comm = 0.0
@@ -247,12 +266,13 @@ def _graph_cost(sim, g: PCG, tp: int, kv_div: int, slots: int,
         if decode:
             if node.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
                 kv_bytes += _attention_state_bytes(
-                    node, slots, max_len) // max(kv_div, 1)
+                    node, slots, max_len, kv_dtype) // max(kv_div, 1)
             elif node.op.op_type == OperatorType.OP_LSTM:
                 h = int(node.op.attrs["hidden_size"])
                 kv_bytes += slots * 2 * h * size_of_datatype(
                     node.op.data_type)
-    kv_time = kv_bytes / (m.hbm_bandwidth * m.hbm_efficiency)
+    kv_time = kv_bytes * max(min(kv_fill, 1.0), 0.0) / (
+        m.hbm_bandwidth * m.hbm_efficiency)
     return t + comm + kv_time, mem_w + kv_bytes + transient, assignment
 
 
@@ -260,11 +280,16 @@ def _graph_cost(sim, g: PCG, tp: int, kv_div: int, slots: int,
 def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                    sim=None, max_inflight: Optional[int] = None,
                    max_decode_len: Optional[int] = None,
-                   slo_p99_ms: Optional[float] = None) -> ServingPlan:
-    """Latency-bounded throughput search over (dp, tp, KV layout) for the
-    decode graph. Returns the winning ServingPlan with the ranked
-    runner-up chain; the warm Simulator rides along for elastic
-    re-searches (``ServingEngine.elastic_replan``)."""
+                   slo_p99_ms: Optional[float] = None,
+                   kv_fill: float = 1.0) -> ServingPlan:
+    """Latency-bounded throughput search over (dp, tp, KV layout,
+    kv_dtype) for the decode graph (kv_dtype ∈ {native, int8} is the
+    ISSUE 12 precision-for-bandwidth axis; ``--kv-dtype`` pins it
+    instead of searching). Returns the winning ServingPlan with the
+    ranked runner-up chain; the warm Simulator rides along for elastic
+    re-searches (``ServingEngine.elastic_replan``). ``kv_fill`` prices
+    the decode KV read at a mean occupancy fraction (paged layout —
+    bench's simulated paged-vs-ring ratio)."""
     import time as _time
 
     from ..obs import SearchLog, get_tracer
@@ -279,6 +304,17 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     max_len = int(max_decode_len or getattr(config, "max_decode_len", 128))
     slo = slo_p99_ms if slo_p99_ms is not None else \
         float(getattr(config, "slo_p99_ms", 0.0) or 0.0)
+    # --kv-dtype pins the axis; the default ("native" config value with
+    # a paged cache) searches both storage dtypes
+    pinned_dtype = str(getattr(config, "kv_dtype", "native") or "native")
+    paged = str(getattr(config, "kv_cache", "paged") or "paged") == "paged"
+    kv_dtypes: Tuple[str, ...]
+    if not paged:
+        kv_dtypes = ("native",)   # int8 is a paged-layout feature
+    elif pinned_dtype != "native":
+        kv_dtypes = (pinned_dtype,)
+    else:
+        kv_dtypes = ("native", "int8")
 
     tracer = get_tracer()
     slog = SearchLog(getattr(config, "search_log_file", "") or None,
@@ -308,21 +344,22 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                 ("replicated",)
             for layout in layouts:
                 kv_div = tp if layout == "sharded" else 1
-                t_dec, mem, assignment = _graph_cost(
-                    active_sim, decode_g, tp, kv_div, s_r, max_len,
-                    decode=True)
-                p50 = t_dec * 1e3
-                p99 = (t_dec + t_pre) * 1e3
-                feas = mem <= hbm and (slo <= 0 or p99 <= slo)
-                out.append((ServingCandidate(
-                    mesh_shape=(dp, tp), layout=layout,
-                    slots_per_replica=s_r,
-                    sim_decode_ms=round(t_dec * 1e3, 4),
-                    sim_prefill_ms=round(t_pre * 1e3, 4),
-                    sim_p50_ms=round(p50, 4), sim_p99_ms=round(p99, 4),
-                    sim_tokens_per_s=slots / t_dec,
-                    sim_memory=int(mem), feasible=bool(feas)),
-                    assignment))
+                for kv_dtype in kv_dtypes:
+                    t_dec, mem, assignment = _graph_cost(
+                        active_sim, decode_g, tp, kv_div, s_r, max_len,
+                        decode=True, kv_dtype=kv_dtype, kv_fill=kv_fill)
+                    p50 = t_dec * 1e3
+                    p99 = (t_dec + t_pre) * 1e3
+                    feas = mem <= hbm and (slo <= 0 or p99 <= slo)
+                    out.append((ServingCandidate(
+                        mesh_shape=(dp, tp), layout=layout,
+                        slots_per_replica=s_r, kv_dtype=kv_dtype,
+                        sim_decode_ms=round(t_dec * 1e3, 4),
+                        sim_prefill_ms=round(t_pre * 1e3, 4),
+                        sim_p50_ms=round(p50, 4), sim_p99_ms=round(p99, 4),
+                        sim_tokens_per_s=slots / t_dec,
+                        sim_memory=int(mem), feasible=bool(feas)),
+                        assignment))
         return out
 
     with tracer.span("serving_search", n_dev=n_dev):
@@ -333,7 +370,8 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                 f"{slots} must be divisible by some dp factor")
         for c, _a in cands:
             slog.log(event="candidate", mesh=list(c.mesh_shape),
-                     layout=c.layout, slots_per_replica=c.slots_per_replica,
+                     layout=c.layout, kv_dtype=c.kv_dtype,
+                     slots_per_replica=c.slots_per_replica,
                      decode_ms=c.sim_decode_ms, prefill_ms=c.sim_prefill_ms,
                      p99_ms=c.sim_p99_ms,
                      tokens_per_s=round(c.sim_tokens_per_s, 2),
@@ -344,7 +382,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
         def rank_key(pair):
             c = pair[0]
             return (not c.feasible, -c.sim_tokens_per_s, c.sim_p99_ms,
-                    repr((c.mesh_shape, c.layout)))
+                    repr((c.mesh_shape, c.layout, c.kv_dtype)))
 
         ordered = sorted(cands, key=rank_key)
         winner, win_assignment = ordered[0]
@@ -356,8 +394,8 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
             fresh = sweep(Simulator(machine))
             fresh_ordered = sorted(fresh, key=rank_key)
             fw = fresh_ordered[0][0]
-            assert (fw.mesh_shape, fw.layout) == (winner.mesh_shape,
-                                                  winner.layout), \
+            assert (fw.mesh_shape, fw.layout, fw.kv_dtype) == \
+                (winner.mesh_shape, winner.layout, winner.kv_dtype), \
                 f"serving selfcheck: cached winner {winner.describe()} != " \
                 f"fresh winner {fw.describe()}"
             for (a, _), (b, _) in zip(ordered, fresh_ordered):
@@ -369,6 +407,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     plan = ServingPlan(
         mesh_shape=winner.mesh_shape, layout=winner.layout, slots=slots,
         max_decode_len=max_len, slo_p99_ms=slo,
+        kv_dtype=winner.kv_dtype,
         sim_decode_ms=winner.sim_decode_ms,
         sim_prefill_ms=winner.sim_prefill_ms,
         sim_p50_ms=winner.sim_p50_ms, sim_p99_ms=winner.sim_p99_ms,
@@ -377,7 +416,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
         assignment=win_assignment,
         ranked=[c for c, _a in ordered], sim=sim)
     slog.log(event="result", mesh=list(winner.mesh_shape),
-             layout=winner.layout,
+             layout=winner.layout, kv_dtype=winner.kv_dtype,
              cost_ms=winner.sim_decode_ms, p99_ms=winner.sim_p99_ms,
              tokens_per_s=round(winner.sim_tokens_per_s, 2),
              mem_mib=round(winner.sim_memory / 2 ** 20, 1),

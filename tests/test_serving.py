@@ -426,15 +426,39 @@ def test_serving_rejects_fused_stateful_regions():
         ServingEngine(ff, max_decode_len=cfg.seq_len)
 
 
-def test_position_table_clamps_decode_ring(gpt2):
-    """A decode ring longer than the position-embedding table would clamp
-    position lookups under jit (silently wrong logits) — the engine warns
-    and clamps the ring to the table instead."""
+def test_position_table_bounds_rejected_at_admission(gpt2):
+    """ISSUE 12 satellite: a decode ring longer than the position-
+    embedding table used to warn-and-clamp at engine construction; now
+    the table bound is the engine's max supported CONTEXT and admission
+    rejects a too-long request with a typed ServingRejection naming the
+    limit — a request that fits still serves at full ring capacity."""
+    from flexflow_tpu.serving.scheduler import (ContextOverflowError,
+                                                ServingRejection)
+
     ff, cfg = gpt2
-    with pytest.warns(UserWarning, match="position table"):
-        eng = ServingEngine(ff, n_slots=2, max_decode_len=999)
-    assert eng.max_decode_len == cfg.seq_len
-    assert max(eng.buckets) <= cfg.seq_len
+    # pool sized in blocks of 16 over max_decode_len 1024; the position
+    # table (seq_len) is the binding context bound
+    eng = ServingEngine(ff, n_slots=2, max_decode_len=1024)
+    assert eng.max_context == cfg.seq_len
+    assert eng.max_decode_len == 1024  # capacity no longer clamped
+    # a request whose prompt + max_new exceeds the table is REJECTED at
+    # admission, naming the max supported context
+    outs = eng.generate([[1, 2, 3]], max_new_tokens=cfg.seq_len + 8)
+    assert outs[0] == []  # shed at admission, empty continuation
+    sched_probe = eng.stats
+    assert sched_probe.outcomes.get("shed", 0) == 1
+    from flexflow_tpu.serving.scheduler import (ContinuousBatchScheduler,
+                                                Request)
+
+    sched = ContinuousBatchScheduler(n_slots=2, max_len=1024)
+    req = Request(prompt=np.arange(4, dtype=np.int32),
+                  max_new_tokens=cfg.seq_len + 8)
+    with pytest.raises(ContextOverflowError,
+                       match="max supported context") as ei:
+        eng.admit(sched, req)
+    assert isinstance(ei.value, ServingRejection)
+    assert str(cfg.seq_len) in str(ei.value)
+    # a request inside the bound serves normally
     outs = eng.generate([[1, 2, 3]], max_new_tokens=4)
     assert len(outs[0]) == 4
 
