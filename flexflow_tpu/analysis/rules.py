@@ -331,16 +331,23 @@ def check_shapes(pcg, strategy) -> List[Diagnostic]:
 def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
                    max_blocks_per_slot: int, max_context: int,
                    kv_layout: str = "replicated",
-                   tp: int = 1) -> List[Diagnostic]:
-    """FF006 extension (ISSUE 12): static shape laws of a paged-KV
-    serving configuration — judged with ZERO compile, so a misconfigured
-    layout is rejected at engine construction (or plan lint), not by an
-    opaque scatter failure ten decode steps in.
+                   tp: int = 1,
+                   prefill_chunk_tokens: int = 0) -> List[Diagnostic]:
+    """FF006 extension (ISSUE 12; chunk laws ISSUE 14): static shape
+    laws of a paged-KV serving configuration — judged with ZERO compile,
+    so a misconfigured layout is rejected at engine construction (or
+    plan lint), not by an opaque scatter failure ten decode steps in.
 
     * ``block_size`` must be positive, and the pool must be whole blocks
       with at least one usable block past the reserved garbage block;
     * the pool must hold at least one max-context request — anything
-      smaller deadlocks admission by construction;
+      smaller deadlocks admission by construction — PLUS one live chunk
+      when chunked prefill is on (the chunk's copy-on-write spare and
+      co-scheduled neighbors otherwise starve);
+    * ``--prefill-chunk-tokens`` must be a whole number of KV blocks:
+      a chunk boundary inside a block would split one block's rows
+      across two chunk programs, breaking the write-before-read law
+      shared blocks rely on;
     * the block TABLE must cover the max supported context
       (``max_blocks_per_slot * block_size >= max_context``): a shorter
       table would silently truncate a legal request's KV extent;
@@ -364,14 +371,29 @@ def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
             message=(f"paged KV: pool has {pool_blocks} block(s); needs "
                      ">= 2 (the reserved garbage block + at least one "
                      "usable block)"), fix_hint=hint))
+    chunk_blocks = 0
+    if prefill_chunk_tokens:
+        if prefill_chunk_tokens % block_size:
+            out.append(Diagnostic(
+                rule_id="FF006", node="",
+                message=(f"chunked prefill: --prefill-chunk-tokens "
+                         f"({prefill_chunk_tokens}) must be a multiple "
+                         f"of --kv-block-size ({block_size}) — a chunk "
+                         "boundary inside a block would split one "
+                         "block's rows across two chunk programs"),
+                fix_hint="pick a chunk size that is a whole number of "
+                         "KV blocks"))
+        chunk_blocks = -(-int(prefill_chunk_tokens) // int(block_size))
     need = -(-int(max_context) // int(block_size))
-    if pool_blocks - 1 < need:
+    if pool_blocks - 1 < need + chunk_blocks:
+        plus = (f" plus one live {prefill_chunk_tokens}-token chunk"
+                if chunk_blocks else "")
         out.append(Diagnostic(
             rule_id="FF006", node="",
             message=(f"paged KV: pool's {pool_blocks - 1} usable blocks "
                      f"({(pool_blocks - 1) * block_size} tokens) cannot "
                      f"hold one max-context request ({max_context} "
-                     "tokens) — admission would deadlock"),
+                     f"tokens){plus} — admission would deadlock"),
             fix_hint=hint))
     if max_blocks_per_slot * block_size < max_context:
         out.append(Diagnostic(

@@ -238,6 +238,25 @@ class FFConfig:
     # head) int8 with f32 scales — ~1/el the decode KV bandwidth, judged
     # against a pinned tolerance band instead of the bitwise contract)
     kv_dtype: str = "native"
+    # prefix cache + chunked prefill (flexflow_tpu/serving/prefix.py,
+    # docs/serving.md "Prefix cache & chunked prefill"; ISSUE 14).
+    # Radix-tree prefix reuse over the paged pool: requests sharing a
+    # cached prompt prefix (>= one full KV block) map its blocks into
+    # their block table with zero prefill compute and prefill only the
+    # suffix. "on" (default; paged, attention-only graphs) or "off".
+    # The hit path is bitwise the cold path, so enabling it changes no
+    # emitted token.
+    prefix_cache: str = "on"
+    # chunked prefill: prompts/suffixes longer than this many tokens
+    # prefill in fixed chunks co-scheduled with decode iterations, so a
+    # long prompt stops head-of-line-blocking the continuous batch.
+    # Must be a whole number of KV blocks (FF006); 0 = off (one-shot
+    # prefill, the legacy behavior).
+    prefill_chunk_tokens: int = 0
+    # steady-state cap (in pool blocks) on what the prefix trie may
+    # retain; 0 = unbounded (LRU eviction still runs under pool
+    # pressure either way)
+    prefix_cache_blocks: int = 0
     # serving resilience (flexflow_tpu/serving/resilience.py,
     # docs/serving.md "Serving under failure"; ISSUE 9).
     # Per-request completion deadline (ms from submission) defaulted onto
@@ -476,6 +495,16 @@ class FFConfig:
                     raise ValueError(
                         f"--kv-dtype expects native|int8, got {v!r}")
                 self.kv_dtype = v
+            elif a == "--prefix-cache":
+                v = _next()
+                if v not in ("on", "off"):
+                    raise ValueError(
+                        f"--prefix-cache expects on|off, got {v!r}")
+                self.prefix_cache = v
+            elif a == "--prefill-chunk-tokens":
+                self.prefill_chunk_tokens = int(_next())
+            elif a == "--prefix-cache-blocks":
+                self.prefix_cache_blocks = int(_next())
             elif a == "--request-timeout-ms":
                 self.request_timeout_ms = float(_next())
             elif a == "--shed-policy":
@@ -588,6 +617,39 @@ class FFConfig:
             raise ValueError(
                 "--kv-dtype int8 requires --kv-cache paged (the ring "
                 "layout stores the model dtype only)")
+        if "--prefix-cache" in seen and self.prefix_cache == "on" and \
+                self.kv_cache == "ring":
+            raise ValueError(
+                "--prefix-cache on requires --kv-cache paged (the ring "
+                "layout has no shared block pool to map a cached prefix "
+                "into)")
+        if "--prefill-chunk-tokens" in seen:
+            if self.prefill_chunk_tokens < 0:
+                raise ValueError(
+                    f"--prefill-chunk-tokens must be >= 0 (got "
+                    f"{self.prefill_chunk_tokens}); 0 disables chunked "
+                    "prefill (one-shot prompts)")
+            if self.prefill_chunk_tokens and self.kv_cache == "ring":
+                raise ValueError(
+                    "--prefill-chunk-tokens requires --kv-cache paged "
+                    "(chunks write into the block pool)")
+            if self.prefill_chunk_tokens % max(self.kv_block_size, 1):
+                raise ValueError(
+                    f"--prefill-chunk-tokens ({self.prefill_chunk_tokens}"
+                    f") must be a multiple of --kv-block-size "
+                    f"({self.kv_block_size}) — a chunk boundary inside a "
+                    "KV block would split one block's rows across two "
+                    "chunk programs (FF006)")
+        if "--prefix-cache-blocks" in seen:
+            if self.prefix_cache_blocks < 0:
+                raise ValueError(
+                    f"--prefix-cache-blocks must be >= 0 (got "
+                    f"{self.prefix_cache_blocks}); 0 leaves trie "
+                    "retention unbounded (pressure eviction still runs)")
+            if self.prefix_cache_blocks and self.prefix_cache == "off":
+                raise ValueError(
+                    "--prefix-cache-blocks is only meaningful with "
+                    "--prefix-cache on; drop it or enable the cache")
         if "--request-timeout-ms" in seen and self.request_timeout_ms < 0:
             raise ValueError(
                 f"--request-timeout-ms must be >= 0 (got "

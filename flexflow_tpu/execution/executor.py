@@ -889,6 +889,81 @@ class Executor:
         self._serving_jits[key] = fn
         return fn
 
+    def make_chunk_prefill_step(self, chunk_len: int, max_decode_len: int,
+                                block_size: int, kv_dtype: str = "native"):
+        """Jitted ``(params, xs, state, table_row, start, n_new) ->
+        (last_logits, new_state)``: ONE prefill chunk of ``chunk_len``
+        token slots for a SINGLE request (batch 1) against the paged
+        pool (ISSUE 14, chunked prefill + prefix-cache suffix prefill).
+        ``xs`` carries the chunk's token ids ``(1, chunk_len)`` (rows
+        beyond ``n_new`` are pad), ``table_row`` the slot's (mb,) int32
+        block-table row, ``start`` the chunk's first position. The
+        chunk's k/v rows are written into the slot's pool blocks and its
+        queries attend over the slot's full gathered extent — the cached
+        prefix (trie hit) and/or earlier chunks plus this chunk — so a
+        long prompt prefills across several co-scheduled iterations and
+        a trie-hit admission prefills only its suffix.
+
+        ``last_logits`` (1, vocab) is the next-token distribution at the
+        chunk's final REAL row — meaningful on the final chunk only
+        (earlier chunks' logits are discarded). One compile per chunk
+        shape (``chunk_len``), like the prefill buckets; ``start`` /
+        ``n_new`` / the table row are traced, so chunk position and
+        block choice never recompile. Numerics are bitwise the one-shot
+        prefill's in every mode — see
+        ``ops.attention._chunk_prefill_attention`` for the argument.
+        ``state`` is donated: the pool updates in place; lengths and
+        block tables pass through untouched (the engine arms the slot's
+        device-side row and cursor only at prefill completion, so decode
+        steps running BETWEEN chunks keep writing the slot's discarded
+        tokens into the garbage block, never into its real blocks)."""
+        import jax
+
+        key = ("chunk", int(chunk_len), int(max_decode_len),
+               int(block_size), str(kv_dtype))
+        cached = self._serving_jits.get(key)
+        if cached is not None:
+            return cached
+        mesh = self.mesh
+        profiling = bool(getattr(self.config, "profiling", False))
+        pos_guids = self._position_const_guids()
+
+        from ..serving.kvcache import DecodeState, ServingState
+
+        def chunk(params, xs, state, table_row, start, n_new):
+            import jax.numpy as jnp
+
+            params, xs = self._cast_for_compute(params, xs)
+            start = jnp.asarray(start, jnp.int32)
+            n_new = jnp.asarray(n_new, jnp.int32)
+            sv = ServingState(mode="chunk", max_len=max_decode_len,
+                              positions=start[None],
+                              lengths=n_new[None],
+                              cache_in=state.caches,
+                              block_tables=table_row[None, :],
+                              block_size=int(block_size),
+                              kv_dtype=str(kv_dtype))
+            ctx = OpContext(training=False, rng=None, mesh=mesh,
+                            profiling=profiling, serving=sv)
+            pos = (start + jnp.arange(chunk_len, dtype=jnp.int32))[None, :]
+            values = self.forward_outputs(
+                params, self._bind_inputs(xs), ctx,
+                overrides=self._serving_overrides(pos_guids, pos))
+            logits = self._logits_f32(
+                values[self.final_guid][self.final_out_idx])
+            idx = jnp.clip(n_new - 1, 0, logits.shape[1] - 1)
+            last = jnp.take_along_axis(
+                logits, idx[None, None, None], axis=1)[:, 0]
+            caches = dict(state.caches)
+            caches.update(sv.cache_out)
+            new_state = DecodeState(caches=caches, lengths=state.lengths,
+                                    block_tables=state.block_tables)
+            return last, new_state
+
+        fn = jax.jit(chunk, donate_argnums=(2,))
+        self._serving_jits[key] = fn
+        return fn
+
     def make_decode_step(self, max_decode_len: int, exact: bool = False,
                          guard: bool = False, block_size: int = 0,
                          kv_dtype: str = "native"):

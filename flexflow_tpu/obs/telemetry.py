@@ -141,6 +141,16 @@ class StepTelemetry:
         self.serving_quarantines: int = 0
         self.serving_drains: int = 0
         self.serving_replans: int = 0
+        # prefix-cache / chunked-prefill counters (ISSUE 14): the
+        # ``serving_prefix`` block — trie hits, prompt tokens whose
+        # prefill was served from cache vs computed, LRU evictions and
+        # chunk-prefill dispatches — filled by
+        # ServingEngine._merge_telemetry
+        self.serving_prefix_hits: int = 0
+        self.serving_prefix_tokens_reused: int = 0
+        self.serving_prefill_tokens_computed: int = 0
+        self.serving_cache_evictions: int = 0
+        self.serving_chunked_prefills: int = 0
         # fleet counters (ISSUE 11): the multi-replica router's run —
         # fleet-wide outcome ledger, per-replica dispatch split,
         # migrations/hedges/failovers and the health machinery's
@@ -155,6 +165,7 @@ class StepTelemetry:
         self.fleet_migrations: int = 0
         self.fleet_hedges: int = 0
         self.fleet_hedge_twin_wins: int = 0
+        self.fleet_affinity_hits: int = 0
         self.fleet_probes: int = 0
         self.fleet_circuit_opens: int = 0
         self.fleet_failovers: int = 0
@@ -307,12 +318,29 @@ class StepTelemetry:
                 "migrations": self.fleet_migrations,
                 "hedges": self.fleet_hedges,
                 "hedge_twin_wins": self.fleet_hedge_twin_wins,
+                "affinity_hits": self.fleet_affinity_hits,
                 "probes": self.fleet_probes,
                 "circuit_opens": self.fleet_circuit_opens,
                 "failovers": self.fleet_failovers,
                 "health_transitions": self.fleet_health_transitions,
             }
             out["fleet"] = fl
+        if (self.serving_prefix_hits or self.serving_prefix_tokens_reused
+                or self.serving_prefill_tokens_computed
+                or self.serving_cache_evictions
+                or self.serving_chunked_prefills):
+            total = (self.serving_prefix_tokens_reused
+                     + self.serving_prefill_tokens_computed)
+            out["serving_prefix"] = {
+                "hits": self.serving_prefix_hits,
+                "tokens_reused": self.serving_prefix_tokens_reused,
+                "tokens_computed": self.serving_prefill_tokens_computed,
+                "reuse_rate": round(
+                    self.serving_prefix_tokens_reused / total, 4)
+                if total else 0.0,
+                "evictions": self.serving_cache_evictions,
+                "chunked_prefills": self.serving_chunked_prefills,
+            }
         if (self.serving_outcomes or self.serving_sheds
                 or self.serving_deadline_misses or self.serving_quarantines
                 or self.serving_drains or self.serving_replans):

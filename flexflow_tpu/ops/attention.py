@@ -212,6 +212,8 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
             f"{name}: serving prefill/decode requires CAUSAL self-attention "
             "(bidirectional attention cannot be decoded incrementally); "
             "build the model with causal=True")
+    if sv.mode == "chunk":
+        return _chunk_prefill_attention(name, q, k, v, sv)
     if sv.mode == "prefill":
         b, h, L, hd = k.shape
         kbuf = lax.dynamic_update_slice(
@@ -277,6 +279,96 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
                             preferred_element_type=jnp.float32) * scale
     kpos = jnp.arange(extent)
     mask = kpos[None, None, None, :] <= sv.positions[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(vc.dtype)
+
+
+def _chunk_prefill_attention(name: str, q, k, v, sv):
+    """One prefill CHUNK for a single slot over the paged pool
+    (ISSUE 14, docs/serving.md "Prefix cache & chunked prefill"): q/k/v
+    carry ``chunk_len`` tokens of ONE request (batch 1) starting at
+    position ``sv.positions[0]``; the chunk's k/v rows are scattered
+    into the slot's pool blocks (pad rows beyond ``sv.lengths[0]`` go to
+    the garbage block) and q attends over the slot's full gathered
+    extent — the already-written prefix (a cached trie hit or earlier
+    chunks) plus this chunk — under the mask ``key_pos <= row_pos``.
+
+    Numerics are BITWISE the one-shot prefill's, by construction, in
+    every engine mode (not just ``exact``): the chunk's score product
+    always rides a full-extent GEMM (chunk rows scattered into a
+    zero-padded extent-row q, the decode-``exact`` idiom) so the d-axis
+    accumulation order matches the whole-sequence forward's; masked
+    lanes — the stale rows of freshly-recycled blocks included — are
+    finite and contribute exp(-1e30 - max) == 0.0 exactly; and the
+    row-wise projections run at the chunk program's fixed compiled
+    width (floor 2 — a 1-row matvec is the one lowering that breaks
+    per-row equality). This is what lets the prefix cache default ON
+    without perturbing a single token of any cold stream: a trie-hit
+    admission's suffix chunk, a chunked long prompt and a cold one-shot
+    prefill all commit identical KV rows and identical next-token
+    logits. The extent-wide score pad is the price (one chunk pays
+    O(extent^2) score FLOPs instead of O(chunk x extent)); chunks run
+    once per admitted prompt, decode runs per token, so the trade
+    follows the decode-``exact`` precedent. int8 pools quantize the
+    chunk rows per-(token, head) on write — band-judged like every
+    int8 path, never bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.kvcache import (dequantize_kv, gather_paged_kv,
+                                   gather_paged_scales, quantize_kv,
+                                   write_chunk_kv_paged,
+                                   write_chunk_scale_paged)
+
+    if not sv.paged:
+        raise NotImplementedError(
+            f"{name}: chunked prefill requires the paged KV layout "
+            "(kv_cache='paged'); the ring layout has no block pool to "
+            "write chunks into")
+    tables, bs = sv.block_tables, sv.block_size  # tables: (1, mb)
+    row = tables[0]
+    start = sv.positions[0]
+    n_new = sv.lengths[0]
+    chunk_len = q.shape[2]
+    pos = start + jnp.arange(chunk_len, dtype=jnp.int32)
+    valid = jnp.arange(chunk_len) < n_new
+    if sv.kv_dtype == "int8":
+        kq, ks, vq, vs = sv.cache_in[name]
+        k_new, ks_new = quantize_kv(k)
+        v_new, vs_new = quantize_kv(v)
+        kq = write_chunk_kv_paged(kq, k_new, pos, valid, row, bs)
+        ks = write_chunk_scale_paged(ks, ks_new, pos, valid, row, bs)
+        vq = write_chunk_kv_paged(vq, v_new, pos, valid, row, bs)
+        vs = write_chunk_scale_paged(vs, vs_new, pos, valid, row, bs)
+        sv.cache_out[name] = (kq, ks, vq, vs)
+        kc = dequantize_kv(gather_paged_kv(kq, tables),
+                           gather_paged_scales(ks, tables), k.dtype)
+        vc = dequantize_kv(gather_paged_kv(vq, tables),
+                           gather_paged_scales(vs, tables), v.dtype)
+    else:
+        kp, vp = sv.cache_in[name]
+        kp = write_chunk_kv_paged(kp, k, pos, valid, row, bs)
+        vp = write_chunk_kv_paged(vp, v, pos, valid, row, bs)
+        sv.cache_out[name] = (kp, vp)
+        kc = gather_paged_kv(kp, tables)
+        vc = gather_paged_kv(vp, tables)
+    extent = kc.shape[2]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    # full-extent score GEMM: chunk q rows scattered at their positions
+    # into a zero extent-row buffer (pad rows dropped out of bounds),
+    # rows re-extracted after the product — the decode-exact idiom
+    safe = jnp.where(valid, pos, extent + 1)
+    qpad = jnp.zeros((1, q.shape[1], extent, q.shape[-1]), q.dtype)
+    qpad = qpad.at[0, :, safe].set(jnp.swapaxes(q[0], 0, 1), mode="drop")
+    full = jnp.einsum("bhqd,bhkd->bhqk", qpad, kc,
+                      preferred_element_type=jnp.float32) * scale
+    logits = jnp.take_along_axis(
+        full, jnp.clip(pos, 0, extent - 1)[None, None, :, None], axis=2)
+    kpos = jnp.arange(extent)
+    mask = kpos[None, None, None, :] <= pos[None, None, :, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,

@@ -50,6 +50,18 @@ class LSTMOp(Op):
         b = x.shape[0]
         h = self.attrs["hidden_size"]
         sv = ctx.serving  # serving engine prefill/decode (ISSUE 6)
+        if sv is not None and sv.mode == "chunk":
+            # chunked/prefix-cached prefill (ISSUE 14) is an
+            # attention-only feature: the LSTM carry is a summary, not
+            # per-token pool rows — there is no block to share or chunk.
+            # The engine disables the prefix cache and refuses
+            # --prefill-chunk-tokens for LSTM graphs at construction;
+            # this raise is the defense-in-depth backstop.
+            raise NotImplementedError(
+                f"{self.name}: chunked/prefix-cached prefill supports "
+                "attention-only stateful graphs; LSTM recurrence has no "
+                "chunk path (serve without --prefill-chunk-tokens and "
+                "with --prefix-cache off)")
         if sv is not None and sv.mode == "decode" and sv.cache_in is not None \
                 and self.name in sv.cache_in:
             # the LSTM's recurrent carry IS its decode state: resume from

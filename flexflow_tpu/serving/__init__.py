@@ -8,10 +8,12 @@ prototype; this subsystem is that story finished in JAX.
 """
 from .kvcache import (DecodeState, GARBAGE_BLOCK,  # noqa: F401
                       KV_DTYPES, ServingState)
-from .scheduler import (BlockAllocator,  # noqa: F401
+from .scheduler import (BlockAccountingError,  # noqa: F401
+                        BlockAllocator,
                         ContextOverflowError, ContinuousBatchScheduler,
                         QueueFullError, Request, ServingRejection,
                         bucket_for, default_buckets)
+from .prefix import PrefixCache, PrefixNode  # noqa: F401
 from .engine import ServingEngine, ServingStats  # noqa: F401
 from .speculative import SpeculativeDecoder  # noqa: F401
 from .resilience import (AdmissionController,  # noqa: F401

@@ -93,6 +93,17 @@ class ServingStats:
     # full n_slots * max_len extent (the O(max_len) bill the paged
     # refactor removes); bench's bytes-read/token column
     kv_bytes_read: int = 0
+    # prefix cache + chunked prefill ledger (ISSUE 14,
+    # serving/prefix.py): admissions that mapped a cached prefix, the
+    # prompt tokens whose prefill compute was skipped vs actually
+    # computed, trie evictions this run, and chunk-prefill dispatches —
+    # the StepTelemetry ``serving_prefix`` block and the bench
+    # shared-prompt sub-leg read these
+    prefix_hits: int = 0
+    prefix_tokens_reused: int = 0
+    prefill_tokens_computed: int = 0
+    cache_evictions: int = 0
+    chunked_prefills: int = 0
     # speculative decoding (serving/speculative.py): per-round drafter
     # proposal/acceptance ledger; acceptance_rate feeds the bench column
     # and keeps the EWMA admission cost model honest
@@ -112,6 +123,15 @@ class ServingStats:
         if not self.spec_proposed:
             return None
         return self.spec_accepted / self.spec_proposed
+
+    def prefix_reuse_rate(self) -> Optional[float]:
+        """Fraction of prefill tokens served from the prefix cache —
+        the measured hit rate ``serving_search(prefill_reuse=)`` prices
+        with. None before any prefill ran."""
+        total = self.prefix_tokens_reused + self.prefill_tokens_computed
+        if not total:
+            return None
+        return self.prefix_tokens_reused / total
 
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         if n:
@@ -167,6 +187,15 @@ class ServingStats:
         acc = self.acceptance_rate()
         if acc is not None:
             out["spec_acceptance"] = round(acc, 4)
+        for k in ("prefix_hits", "prefix_tokens_reused",
+                  "prefill_tokens_computed", "cache_evictions",
+                  "chunked_prefills"):
+            v = getattr(self, k)
+            if v:
+                out[k] = v
+        reuse = self.prefix_reuse_rate()
+        if reuse:
+            out["prefix_reuse_rate"] = round(reuse, 4)
         return out
 
 
@@ -191,7 +220,10 @@ class ServingEngine:
                  kv_cache: Optional[str] = None,
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: Optional[str] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_cache_blocks: Optional[int] = None):
         assert model.executor is not None, "call model.compile() first"
         self.model = model
         self.executor = model.executor
@@ -230,14 +262,58 @@ class ServingEngine:
             raise ValueError(
                 "kv_dtype='int8' requires the paged KV layout "
                 "(kv_cache='paged')")
+        # prefix cache + chunked prefill (ISSUE 14, serving/prefix.py,
+        # docs/serving.md "Prefix cache & chunked prefill"): the radix
+        # trie defaults ON for paged attention-only graphs — its hit
+        # path is bitwise the cold path, so enabling it changes no
+        # stream; chunking is opt-in via --prefill-chunk-tokens
+        self.prefill_chunk_tokens = int(
+            prefill_chunk_tokens if prefill_chunk_tokens is not None
+            else getattr(cfg, "prefill_chunk_tokens", 0) or 0)
+        prefix_mode = str(prefix_cache or
+                          getattr(cfg, "prefix_cache", "on") or "on")
+        if prefix_mode not in ("on", "off"):
+            raise ValueError(
+                f"prefix_cache must be 'on' or 'off', got {prefix_mode!r}")
+        if self.kv_cache == "ring":
+            if prefix_cache == "on":
+                raise ValueError(
+                    "prefix_cache='on' requires the paged KV layout "
+                    "(kv_cache='paged'): the ring layout has no shared "
+                    "block pool to map a cached prefix into")
+            if self.prefill_chunk_tokens:
+                raise ValueError(
+                    "prefill_chunk_tokens requires the paged KV layout "
+                    "(kv_cache='paged'): chunks write into the block "
+                    "pool")
+            prefix_mode = "off"
         # max supported context: bounded by the position-embedding table
         # when it is shorter than the ring/pool capacity; admission
         # REJECTS beyond it (the old warn-and-clamp is gone, ISSUE 12
         # satellite)
         self._validate_graph()
+        has_lstm = any(
+            n.op.op_type == OperatorType.OP_LSTM
+            for n in self.executor.pcg.compute_nodes())
+        if has_lstm:
+            # the LSTM carry is a summary, not per-token pool rows:
+            # there is no block to share or chunk (ISSUE 14 scope —
+            # attention-only stateful graphs)
+            if self.prefill_chunk_tokens:
+                raise ValueError(
+                    "prefill_chunk_tokens: chunked prefill supports "
+                    "attention-only stateful graphs; this model has "
+                    "LSTM recurrence")
+            if prefix_cache == "on":
+                raise ValueError(
+                    "prefix_cache='on': prefix caching supports "
+                    "attention-only stateful graphs; this model has "
+                    "LSTM recurrence")
+            prefix_mode = "off"
         self.max_context = position_context_bound(self.executor,
                                                   self.max_decode_len)
         self.block_allocator = None
+        self._prefix = None
         if self.kv_cache == "paged":
             from .scheduler import BlockAllocator
 
@@ -245,8 +321,14 @@ class ServingEngine:
             self.max_blocks_per_slot = mb
             # auto pool: full capacity (every slot at max_len) + the
             # garbage block — --kv-pool-blocks decouples occupancy from
-            # max_len (admission then waits on FREE BLOCKS, not slots)
-            self.kv_pool_blocks = kv_pool_blocks or (self.n_slots * mb + 1)
+            # max_len (admission then waits on FREE BLOCKS, not slots).
+            # Chunked prefill adds one live chunk's worth of headroom
+            # (the FF006 law: one max-context request PLUS one chunk)
+            chunk_blocks = (-(-self.prefill_chunk_tokens //
+                              self.kv_block_size)
+                            if self.prefill_chunk_tokens else 0)
+            self.kv_pool_blocks = kv_pool_blocks or (
+                self.n_slots * mb + 1 + chunk_blocks)
             # ShardLint FF006 paged shape laws — statically, zero compile
             from ..analysis import (AnalysisReport, StaticAnalysisError,
                                     check_paged_kv)
@@ -256,13 +338,23 @@ class ServingEngine:
                 block_size=self.kv_block_size,
                 pool_blocks=self.kv_pool_blocks,
                 max_blocks_per_slot=mb,
-                max_context=self.max_context)
+                max_context=self.max_context,
+                prefill_chunk_tokens=self.prefill_chunk_tokens)
             if diags:
                 raise StaticAnalysisError(
                     AnalysisReport(diagnostics=diags, checked=("FF006",)),
                     context="paged KV configuration")
             self.block_allocator = BlockAllocator(self.kv_pool_blocks,
                                                   self.kv_block_size)
+            if prefix_mode == "on":
+                from .prefix import PrefixCache
+
+                self._prefix = PrefixCache(
+                    self.block_allocator, self.kv_block_size,
+                    max_blocks=int(
+                        prefix_cache_blocks
+                        if prefix_cache_blocks is not None
+                        else getattr(cfg, "prefix_cache_blocks", 0) or 0))
         self.buckets = tuple(buckets) if buckets else \
             default_buckets(self.max_decode_len)
         self.state: Optional[DecodeState] = None
@@ -507,6 +599,110 @@ class ServingEngine:
             row[:len(req.kv_blocks)] = req.kv_blocks
         return row
 
+    # ------------------------------------------------- prefix cache (ISSUE 14)
+    def _chunk_fn(self, chunk_shape: int):
+        return self.executor.make_chunk_prefill_step(
+            int(chunk_shape), self.max_decode_len, self.kv_block_size,
+            self.kv_dtype)
+
+    def _cow_clone(self, src: int, dst: int) -> None:
+        """Copy-on-write clone: duplicate pool block ``src`` into the
+        freshly-allocated ``dst`` across every paged cache entry (int8
+        scale arrays included) before the cloner's first divergent
+        write. One tiny donated jit with traced block ids — exactly the
+        ``_clear_slot_tables`` idiom — so COW never recompiles. The
+        sharer's block is read, never written: its rows stay bitwise
+        untouched (tests/test_prefix_cache.py pins the isolation)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.state is None:
+            return  # no pool yet: nothing to clone from
+        if getattr(self, "_cow_clone_fn", None) is None:
+            paged_names = set(self._paged_entry_names)
+
+            def clone(state, src, dst):
+                caches = {}
+                for name, entry in state.caches.items():
+                    if name in paged_names:
+                        caches[name] = tuple(
+                            leaf.at[dst].set(leaf[src]) for leaf in entry)
+                    else:
+                        caches[name] = entry
+                return DecodeState(caches=caches, lengths=state.lengths,
+                                   block_tables=state.block_tables)
+
+            self._cow_clone_fn = jax.jit(clone, donate_argnums=(0,))
+        self.state = self._cow_clone_fn(self.state, jnp.int32(src),
+                                        jnp.int32(dst))
+
+    def _set_slot_meta(self, slot: int, length: int, token: int,
+                       table_row: np.ndarray) -> None:
+        """Arm a chunk-prefilled slot for decode: set its device-side
+        length cursor, block-table row and pending first token — the
+        pool rows were already written by the chunks, so this is the
+        ``_write_slot`` tail without the ring scatter. Traced indices:
+        no recompiles."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self, "_set_slot_meta_fn", None) is None:
+            def meta(state, last, slot, length, token, table_row):
+                tables = state.block_tables
+                if tables is not None:
+                    tables = tables.at[slot].set(table_row)
+                return (DecodeState(caches=state.caches,
+                                    lengths=state.lengths.at[slot].set(
+                                        length),
+                                    block_tables=tables),
+                        last.at[slot, 0].set(token))
+
+            self._set_slot_meta_fn = jax.jit(meta, donate_argnums=(0, 1))
+        self.state, self._last_tokens = self._set_slot_meta_fn(
+            self.state, self._last_tokens, jnp.int32(slot),
+            jnp.int32(length), jnp.int32(token),
+            jnp.asarray(table_row, jnp.int32))
+
+    def _ensure_state_bootstrap(self) -> None:
+        """A chunk action needs the pool, but the pool structure comes
+        from a prefill cache and none has run yet (first-ever admission
+        went straight to the chunk path): derive it from one smallest-
+        bucket prefill on a dummy token — the same program the health
+        probe dispatches, so steady-state this is a warm compile and
+        the cache content is discarded (``_ensure_state`` builds
+        zeroed pools from its STRUCTURE only)."""
+        import jax.numpy as jnp
+
+        if self.state is not None:
+            return
+        b0 = self.buckets[0]
+        ids = np.zeros((1, b0), np.int32)
+        _lg, _last, cache = self._prefill_fn(b0)(
+            self.model.params, [jnp.asarray(ids)],
+            jnp.asarray([1], jnp.int32))
+        self._ensure_state(cache)
+        # normalize through the classic slot writer — a value-level
+        # no-op (dummy cache scattered at an all-garbage row, slot 0,
+        # length 0, token 0) whose OUTPUT carries the same committed
+        # placement every later step input will: the chunk program then
+        # compiles exactly once per shape (an uncommitted first input
+        # would key a second fastpath entry)
+        self._write_slot(cache, 0, 0, 0,
+                         table_row=np.zeros((self.max_blocks_per_slot,),
+                                            np.int32))
+
+    def prefix_peek(self, tokens, cap: Optional[int] = None) -> int:
+        """Longest cached-prefix length (tokens) the engine's trie holds
+        for ``tokens`` — no LRU touch, no counters. The fleet router's
+        cache-affinity term (ISSUE 14: route a request to the replica
+        whose trie holds its longest prefix); 0 for prefix-less
+        engines."""
+        if self._prefix is None:
+            return 0
+        n = len(tokens)
+        return self._prefix.peek(tokens, cap=n - 1 if cap is None
+                                 else cap)
+
     def _ensure_state(self, prefill_cache) -> None:
         """Allocate the slot-pool DecodeState lazily from the first
         prefill's cache structure (zeros; every slot's rows are fully
@@ -521,6 +717,12 @@ class ServingEngine:
 
         if self.state is not None:
             return
+        if self._prefix is not None and self._prefix.n_blocks:
+            # building a FRESH pool (first admission after a device-loss
+            # rebuild): every cached block id would dangle into zeroed
+            # arrays — drop the trie, returning its references, before
+            # anything can match stale pointers
+            self._prefix.clear(free=True)
         n = self.n_slots
         tables = None
         if self._paged:
@@ -629,6 +831,10 @@ class ServingEngine:
         if self.block_allocator is not None:
             sched.allocator = self.block_allocator
             sched.on_slot_freed = self._clear_slot_tables
+            # prefix cache + chunked prefill (ISSUE 14): admission walks
+            # the trie and long suffixes/prompts take the chunk path
+            sched.prefix = self._prefix
+            sched.chunk_tokens = self.prefill_chunk_tokens
         if self.max_context < sched.max_len:
             sched.max_context = self.max_context
 
@@ -780,6 +986,11 @@ class ServingEngine:
         live anymore; survivors' re-prefills allocate fresh tables)."""
         self.state = None
         self._last_tokens = None
+        if self._prefix is not None:
+            # the cached blocks die with the pool arrays; the allocator
+            # reset below forgets refcounts wholesale, so the trie just
+            # drops its nodes without per-block decrements
+            self._prefix.clear(free=False)
         if self.block_allocator is not None:
             self.block_allocator.reset()
 
@@ -954,6 +1165,13 @@ class ServingEngine:
         tel.serving_quarantines = stats.quarantines
         tel.serving_drains = stats.drains
         tel.serving_replans = stats.replans
+        # serving_prefix block (ISSUE 14): the prefix-cache/chunked-
+        # prefill ledger, mirroring the serving_resilience block
+        tel.serving_prefix_hits = stats.prefix_hits
+        tel.serving_prefix_tokens_reused = stats.prefix_tokens_reused
+        tel.serving_prefill_tokens_computed = stats.prefill_tokens_computed
+        tel.serving_cache_evictions = stats.cache_evictions
+        tel.serving_chunked_prefills = stats.chunked_prefills
         tel.finalize()
         if self.model.config.telemetry_file:
             tel.write(self.model.config.telemetry_file)
@@ -973,8 +1191,12 @@ class ServingEngine:
         bit-identical continuations across a replan)."""
         from .search import serving_search
 
+        # price prefill with the MEASURED prefix-cache hit rate of the
+        # run so far (ISSUE 14: the latency-bounded objective sees the
+        # real expected prefill cost, not the cold-cache worst case)
+        reuse = self.stats.prefix_reuse_rate() or 0.0
         plan = serving_search(self.executor.pcg, self.model.config, n_dev,
-                              sim=self._search_sim)
+                              sim=self._search_sim, prefill_reuse=reuse)
         self._search_sim = plan.sim
         self.plan = plan
         # drop and rebuild the serving jits — the migration recompile the
@@ -987,6 +1209,12 @@ class ServingEngine:
                          mesh=list(plan.mesh_shape),
                          tokens_per_s=round(plan.sim_tokens_per_s, 1))
         return plan
+
+
+def _state_lost(state) -> bool:
+    from .resilience import state_buffers_lost
+
+    return state_buffers_lost(state)
 
 
 class _ServeLoop:
@@ -1055,6 +1283,21 @@ class _ServeLoop:
         self.draining = False
         self.drain_deadline_ms = None
         self.finished = False
+        # prefix cache (ISSUE 14): a trie that outlived its pool (the
+        # caller dropped eng.state, or buffers died with a device) must
+        # be cleared BEFORE the first admission can match stale block
+        # ids into the zeroed rebuild
+        if eng._prefix is not None and eng._prefix.n_blocks and (
+                eng.state is None or _state_lost(eng.state)):
+            eng._prefix.clear(free=True)
+        # per-run deltas against persistent counters — the trie (and a
+        # caller-reused scheduler) outlive this run, so finish()
+        # reports differences, not totals
+        self._chunk_walls: Dict[int, float] = {}
+        self._prefix_hits0 = sched.prefix_hits
+        self._prefix_reused0 = sched.prefix_tokens_reused
+        self._evictions0 = (eng._prefix.evictions
+                            if eng._prefix is not None else 0)
         self.t0 = time.perf_counter()
 
     # ---------------------------------------------------------------- drain
@@ -1134,10 +1377,12 @@ class _ServeLoop:
                                         np.int32))[0]))
             wall = time.perf_counter() - t_p
             stats.prefills += 1
+            stats.prefill_tokens_computed += eff
             stats.record_token(wall)
             stats.tokens_generated += 1
             if req.first_token_step is None:
                 req.first_token_step = self.step_no
+                req.first_token_ms = float(res.clock())
             if tracer.enabled:
                 tracer.complete("prefill", wall, rid=req.rid,
                                 bucket=bucket, slot=slot, prompt_len=eff)
@@ -1145,6 +1390,86 @@ class _ServeLoop:
                 eng._write_slot(cache, slot, eff, tok,
                                 table_row=(eng._table_row_for(req)
                                            if eng._paged else None))
+                # mark completion (the pool holds the prompt's KV now)
+                # and eagerly cache the FULL prompt blocks so same-batch
+                # shared-prefix admissions already hit; the partial tail
+                # is adopted later, at release, so the request's own
+                # decode writes into it never trigger a self-COW
+                req.prefill_pos = req.prefill_target
+                if eng._prefix is not None and req.kv_blocks:
+                    full = eff // eng.kv_block_size
+                    if full:
+                        eng._prefix.insert(cur[:full * eng.kv_block_size],
+                                           req.kv_blocks[:full])
+            return True
+        if action[0] == "prefill_chunk":
+            # chunked prefill / prefix-suffix prefill (ISSUE 14): one
+            # fixed-width chunk of ONE slot's prompt, co-scheduled with
+            # the other slots' decode steps (the scheduler alternates),
+            # so a long prompt never head-of-line-blocks the batch and a
+            # trie-hit admission computes only its suffix
+            _, req, slot, start, n, shape = action
+            if self.res_active and req.expired(res.clock()):
+                res.deadline_misses += 1
+                sched.evict(slot, "deadline_exceeded")
+                self._chunk_walls.pop(req.rid, None)
+                return True
+            t_p = time.perf_counter()
+            eng._ensure_state_bootstrap()
+            if req.pending_cow is not None:
+                # first divergent write into a shared partial tail
+                # block: clone it before this chunk touches it
+                src, dst = req.pending_cow
+                eng._cow_clone(src, dst)
+                sched.release_cow(req)
+                if tracer.enabled:
+                    tracer.event("prefix_cow_clone", rid=req.rid,
+                                 slot=slot, src=src, dst=dst)
+            cur = req.current_prompt()
+            ids = np.zeros((1, shape), np.int32)
+            ids[0, :n] = cur[start:start + n]
+            row = eng._table_row_for(req)
+            last, eng.state = eng._chunk_fn(shape)(
+                self.params, [jnp.asarray(ids)], eng.state,
+                jnp.asarray(row, jnp.int32), jnp.int32(start),
+                jnp.int32(n))
+            stats.prefill_tokens_computed += n
+            stats.chunked_prefills += 1
+            done = sched.chunk_done(slot, n)
+            wall = time.perf_counter() - t_p
+            self._chunk_walls[req.rid] = \
+                self._chunk_walls.get(req.rid, 0.0) + wall
+            if tracer.enabled:
+                tracer.complete("prefill_chunk", wall, rid=req.rid,
+                                slot=slot, start=start, tokens=n,
+                                hit=req.prefix_hit_tokens, done=done)
+            if not done:
+                return True
+            eff = req.prefill_target
+            tag = req.rng_tag if req.rng_tag is not None else req.rid
+            tok = int(jax.device_get(
+                self.sampler(last, self.base_rng,
+                             np.asarray([[tag, len(req.generated)]],
+                                        np.int32))[0]))
+            stats.prefills += 1
+            stats.record_token(self._chunk_walls.pop(req.rid, wall))
+            stats.tokens_generated += 1
+            if req.first_token_step is None:
+                req.first_token_step = self.step_no
+                req.first_token_ms = float(res.clock())
+            if eng._prefix is not None and req.kv_blocks:
+                full = eff // eng.kv_block_size
+                if full:
+                    eng._prefix.insert(cur[:full * eng.kv_block_size],
+                                       req.kv_blocks[:full])
+            if not sched.commit_token(slot, tok):
+                # arm the slot for decode: the chunks already wrote the
+                # pool rows, so only the device-side cursor/table/token
+                # remain (the row stayed garbage during chunking — the
+                # decode steps running between chunks wrote this slot's
+                # discarded tokens into the garbage block, never into
+                # its real blocks)
+                eng._set_slot_meta(slot, eff, tok, row)
             return True
         # decode: one token for every live slot. Sampling covers ALL
         # slots (free ones with a dummy rng, their draws discarded) so
@@ -1187,7 +1512,21 @@ class _ServeLoop:
             # deleted buffers
             eng.state = None
             eng._last_tokens = None
-            for slot, req in live:
+            if eng._prefix is not None:
+                # the cached blocks died with the pool: drop the trie
+                # BEFORE the quarantined requests re-enter admission,
+                # or their re-prefills would map stale block ids into
+                # the zeroed rebuild
+                eng._prefix.clear(free=True)
+            # EVERY occupied slot re-enters — mid-chunk prefills
+            # included (their partially-written pool rows died with the
+            # pool; re-admission restarts the prefill, re-walking the
+            # trie, which _ensure_state cleared alongside the pool)
+            requeued = 0
+            for slot, req in enumerate(list(sched.slots)):
+                if req is None:
+                    continue
+                requeued += 1
                 try:
                     bucket_for(req.effective_len, sched.buckets)
                 except ValueError:
@@ -1196,7 +1535,7 @@ class _ServeLoop:
                 sched.quarantine(slot)
             if tracer.enabled:
                 tracer.event("serving_state_rebuild", step=k,
-                             requeued=len(live))
+                             requeued=requeued)
             return True
         live_map = dict(live)
         # per-slot rng streams depend on (submission tag, tokens
@@ -1273,6 +1612,14 @@ class _ServeLoop:
         stats.drains = res.drains
         stats.replans = res.replans
         stats.drained_returned = len(eng.drained_requests)
+        # prefix-cache ledger (ISSUE 14): deltas vs the loop-start
+        # snapshots — the trie and a caller-reused scheduler persist
+        stats.prefix_hits = sched.prefix_hits - self._prefix_hits0
+        stats.prefix_tokens_reused = \
+            sched.prefix_tokens_reused - self._prefix_reused0
+        if eng._prefix is not None:
+            stats.cache_evictions = \
+                eng._prefix.evictions - self._evictions0
         if self.publish_telemetry:
             eng._merge_telemetry(sched, stats)
             if tracer.enabled and eng.model.config.trace_file:

@@ -17,10 +17,14 @@ drives them in ONE host loop: each **fleet tick** advances every live
 replica by one scheduler action via the ``_ServeLoop.tick()`` hook the
 ISSUE 11 engine refactor exposed. On top of that loop:
 
-* **load-aware dispatch** — least-outstanding-tokens routing: each
-  queued request goes to the dispatchable replica with the smallest
-  estimated drain time (outstanding tokens x the replica's warm
-  ``AdmissionController`` EWMA per-token cost).
+* **load-aware, prefix-aware dispatch** — each queued request is
+  scored per replica as estimated drain time MINUS the priced
+  cache-affinity saving (cached prefix tokens x the replica's warm
+  ``AdmissionController`` EWMA per-token cost; ISSUE 14): the replica
+  that can skip the most prefill compute wins until its queueing delay
+  outgrows the saving. Migration re-prefills flow through the same
+  gate, so a migrated stream lands on the survivor already holding its
+  prefix whenever one exists.
 * **health-checked failover** — per-replica health
   (``healthy | degraded | quarantined | draining | dead``) driven by a
   probe decode (``ServingEngine.health_probe``) plus passive signals
@@ -235,6 +239,11 @@ class FleetStats:
     hedges: int = 0
     hedge_twin_wins: int = 0
     hedges_cancelled: int = 0
+    # prefix-aware routing (ISSUE 14): dispatches whose replica choice
+    # was driven by a cache-affinity hit (the chosen replica's radix
+    # trie held a prefix of the request), and the token volume matched
+    affinity_hits: int = 0
+    affinity_tokens: int = 0
     probes: int = 0
     probe_failures: int = 0
     circuit_opens: int = 0
@@ -292,7 +301,8 @@ class FleetStats:
         if self.outcomes:
             out["outcomes"] = dict(self.outcomes)
         for k in ("sheds", "migrations", "requeued", "failovers", "hedges",
-                  "hedge_twin_wins", "hedges_cancelled", "probes",
+                  "hedge_twin_wins", "hedges_cancelled", "affinity_hits",
+                  "affinity_tokens", "probes",
                   "probe_failures", "circuit_opens", "drains", "rejoins",
                   "degrade_poisons"):
             v = getattr(self, k)
@@ -639,14 +649,23 @@ class ServingFleet:
                 and not self._fleet_draining)
 
     def _dispatch(self) -> None:
-        """Load-aware routing: every queued request goes to the
-        dispatchable replica with the smallest estimated drain time
-        (least-outstanding-tokens x its warm EWMA per-token cost;
-        outstanding tokens, then index, break ties deterministically).
-        Expired door-queued requests are dropped first (outcome
-        ``deadline_exceeded``) — a request stuck at the door while every
-        circuit is open must not be served seconds past its deadline
-        with zero misses recorded."""
+        """Prefix-aware, load-aware routing (ISSUE 14): each queued
+        request is scored per replica as estimated drain time MINUS the
+        priced cache-affinity saving — the tokens of its prompt the
+        replica's radix trie already holds, times that replica's EWMA
+        per-token cost (prefilling them there costs nothing; doing it
+        on a trie-cold replica throws the win away — and migration
+        re-prefills flow through the same gate, so survivors' tries are
+        consulted). Pricing rather than strict affinity-first keeps the
+        router honest under load: a bounded prefill saving can never
+        buy unbounded queueing on one warm replica. Raw affinity, then
+        outstanding tokens, then index, stay the deterministic
+        tie-breaks (a cold cost model scores every replica 0, where
+        affinity alone decides). Expired door-queued requests are
+        dropped first
+        (outcome ``deadline_exceeded``) — a request stuck at the door
+        while every circuit is open must not be served seconds past its
+        deadline with zero misses recorded."""
         now = self.clock()
         expired = [r for r in self.queue if r.expired(now)]
         for req in expired:
@@ -660,8 +679,36 @@ class ServingFleet:
             if not targets:
                 return
             req = self.queue.popleft()
-            rep = min(targets, key=lambda r: (
-                r.drain_estimate_ms(), r.outstanding_tokens(), r.idx))
+            # hoist the prompt materialization (np.concatenate) out of
+            # the per-replica probe loop
+            toks = req.current_prompt()
+            cap = req.effective_len - 1
+            aff = {r.idx: r.engine.prefix_peek(toks, cap=cap)
+                   for r in targets}
+            # the affinity term is PRICED, not absolute: a cached
+            # prefix is worth its skipped prefill compute (matched
+            # tokens x the replica's EWMA per-token cost), so the
+            # effective score is drain-time minus that saving — a
+            # warm-trie replica loses the request the moment its
+            # queueing delay exceeds what the cache would save
+            # (concentrating unbounded traffic on one replica for a
+            # bounded prefill win would invert the feature). With a
+            # cold EWMA every term is 0 and the raw affinity breaks
+            # the tie.
+            def score(r):
+                cost = r.engine.admission.token_cost_ms
+                return (r.drain_estimate_ms() - aff[r.idx] * cost,
+                        -aff[r.idx], r.outstanding_tokens(), r.idx)
+
+            rep = min(targets, key=score)
+            if aff[rep.idx] > 0:
+                self.stats.affinity_hits += 1
+                self.stats.affinity_tokens += aff[rep.idx]
+                tracer = self._tracer()
+                if tracer.enabled:
+                    tracer.event("fleet_affinity", rid=req.rid,
+                                 tick=self.tick_no, replica=rep.idx,
+                                 tokens=aff[rep.idx])
             assert rep.loop is not None and rep.sched is not None
             rep.loop.res.stamp_deadline(req)
             # a migrated/rescued request already carries a submit stamp:
@@ -1201,6 +1248,7 @@ class ServingFleet:
         tel.fleet_migrations = st.migrations
         tel.fleet_hedges = st.hedges
         tel.fleet_hedge_twin_wins = st.hedge_twin_wins
+        tel.fleet_affinity_hits = st.affinity_hits
         tel.fleet_probes = st.probes
         tel.fleet_circuit_opens = st.circuit_opens
         tel.fleet_failovers = st.failovers

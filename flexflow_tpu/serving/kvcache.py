@@ -28,8 +28,11 @@ the per-slot ``max_len`` ring buffers become ONE pool of fixed-size KV
 blocks per stateful node — ``(n_blocks, heads, block_size, head_dim)`` —
 plus a per-slot **block table** ``(n_slots, max_blocks_per_slot)`` int32
 mapping each slot's logical positions onto pool blocks. Slot recycling
-and (future) prefix sharing are pointer bookkeeping in the host-side
-:class:`~flexflow_tpu.serving.scheduler.BlockAllocator`; pool occupancy
+and prefix sharing are pointer bookkeeping in the host-side
+:class:`~flexflow_tpu.serving.scheduler.BlockAllocator` (prefix sharing
+delivered by ISSUE 14's radix-tree cache, serving/prefix.py: shared
+blocks are refcounted, divergent writes clone first — copy-on-write);
+pool occupancy
 decouples from ``max_len`` (a short request holds few blocks); and the
 single-compile decode contract survives — block tables are just another
 int32 array in the jitted signature. Block index 0 is the reserved
@@ -67,15 +70,21 @@ INT8_QMAX = 127.0
 class ServingState:
     """Per-forward serving context threaded as ``OpContext.serving``.
 
-    mode:      "prefill" (whole padded prompt) or "decode" (one token/slot)
+    mode:      "prefill" (whole padded prompt), "decode" (one token/slot)
+               or "chunk" (ISSUE 14: one fixed-width prefill chunk for a
+               SINGLE slot — batch 1 — writing its k/v rows into the
+               slot's pool blocks and attending over the slot's gathered
+               extent; the chunked-prefill and prefix-suffix program)
     max_len:   ring-buffer capacity — the static sequence axis of every
                cache entry (``--max-decode-len``)
     positions: (batch,) int32 — the first position this call writes
-               (zeros for prefill; ``DecodeState.lengths`` for decode)
+               (zeros for prefill; ``DecodeState.lengths`` for decode;
+               the chunk's start position for chunk mode)
     lengths:   (batch,) int32 true prompt lengths (prefill only — the LSTM
                carry must be read at position length-1, not at the padded
                tail; attention needs no lengths, its causal mask + the
-               decode-side position mask cover padding)
+               decode-side position mask cover padding). Chunk mode reuses
+               it for the chunk's REAL token count (rows beyond are pad).
     cache_in:  {node_name: state pytree} consumed by decode
     cache_out: {node_name: state pytree} every stateful op fills
     exact:     decode-numerics mode: True routes the attention score
@@ -270,6 +279,38 @@ def write_token_scale_paged(scales, scale_new, positions, block_tables,
         block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
     off = positions % block_size
     return scales.at[bi, :, off].set(scale_new[:, :, 0])
+
+
+def write_chunk_kv_paged(pool, new, positions, valid, table_row,
+                         block_size):
+    """Scatter one prefill CHUNK's k or v rows ``(1, h, C, hd)`` into
+    the block pool at ``positions`` (C,) of the single slot owning
+    ``table_row`` (mb,) — the chunked-prefill / prefix-suffix write
+    (ISSUE 14). Invalid (pad) rows beyond the chunk's real token count
+    are routed to the GARBAGE block (finite garbage, never read); valid
+    rows land at (table[pos // bs], pos % bs) like the decode-step
+    write. No arithmetic on stored values."""
+    import jax.numpy as jnp
+
+    mb = table_row.shape[0]
+    blk = jnp.clip(positions // block_size, 0, mb - 1)
+    bi = jnp.where(valid, table_row[blk], GARBAGE_BLOCK)
+    off = positions % block_size
+    rows = jnp.swapaxes(new[0], 0, 1)  # (h, C, hd) -> (C, h, hd)
+    return pool.at[bi, :, off].set(rows.astype(pool.dtype))
+
+
+def write_chunk_scale_paged(scales, scale_new, positions, valid,
+                            table_row, block_size):
+    """Scale-array twin of :func:`write_chunk_kv_paged`:
+    ``scales (n_blocks, h, bs)``, ``scale_new (1, h, C)``."""
+    import jax.numpy as jnp
+
+    mb = table_row.shape[0]
+    blk = jnp.clip(positions // block_size, 0, mb - 1)
+    bi = jnp.where(valid, table_row[blk], GARBAGE_BLOCK)
+    off = positions % block_size
+    return scales.at[bi, :, off].set(jnp.swapaxes(scale_new[0], 0, 1))
 
 
 def gather_paged_kv(pool, block_tables):

@@ -86,8 +86,19 @@ class ContextOverflowError(ServingRejection):
     position rows)."""
 
 
+class BlockAccountingError(RuntimeError):
+    """A paged-KV block operation violated the allocator's refcount laws
+    (ISSUE 14 satellite): double-free (freeing a block whose refcount is
+    already 0), sharing a free block, or touching the reserved garbage
+    block. Before refcounts these corrupted the FIFO free list SILENTLY
+    — the same block handed to two live requests, KV cross-talk with no
+    error at the scene of the crime — so the laws are now typed and
+    loud."""
+
+
 class BlockAllocator:
-    """Host-side free-list allocator over the paged KV pool (ISSUE 12).
+    """Host-side refcounted free-list allocator over the paged KV pool
+    (ISSUE 12; refcounts + copy-on-write support ISSUE 14).
 
     The pool is ``n_blocks`` fixed-size blocks of ``block_size`` tokens;
     block ``GARBAGE_BLOCK`` (0) is reserved — unused table entries point
@@ -98,7 +109,17 @@ class BlockAllocator:
     cancellation) returns the blocks through the scheduler's one
     ``_release_blocks`` choke point. Pure host bookkeeping — deterministic
     FIFO free list, so the schedule stays a function of the submission
-    sequence."""
+    sequence.
+
+    Prefix sharing (ISSUE 14, serving/prefix.py): a block may be mapped
+    by several requests' block tables at once — the radix-tree prefix
+    cache plus every request currently reusing that prefix. ``share``
+    grows the refcount, ``free`` decrements it, and the block returns to
+    the FIFO free list only at refcount 0; sharers never write into a
+    shared block (a divergent write clones it first — the COW path), so
+    refcounts are pure bookkeeping, not synchronization. The refcount
+    laws (alloc/share/free round-trips, zero leaks under churn) are
+    pinned property-style in tests/test_prefix_cache.py."""
 
     def __init__(self, n_blocks: int, block_size: int):
         assert n_blocks >= 2, "paged pool needs >= 1 usable block " \
@@ -107,6 +128,10 @@ class BlockAllocator:
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
         self.free_blocks: Deque[int] = deque(range(1, self.n_blocks))
+        # refcounts[b] > 0 <=> b is live (mapped by >= 1 request table
+        # and/or retained by the prefix trie); the garbage block is
+        # never allocated and keeps refcount 0
+        self.refcounts: List[int] = [0] * self.n_blocks
         self.blocks_hwm = 0
 
     @property
@@ -120,22 +145,70 @@ class BlockAllocator:
     def blocks_needed(self, tokens: int) -> int:
         return -(-max(int(tokens), 1) // self.block_size)
 
+    def refcount(self, block: int) -> int:
+        return self.refcounts[int(block)]
+
+    def _check(self, block: int) -> int:
+        b = int(block)
+        if b <= 0 or b >= self.n_blocks:
+            raise BlockAccountingError(
+                f"block {b} is outside the pool (usable ids 1.."
+                f"{self.n_blocks - 1}; 0 is the reserved garbage block)")
+        return b
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None when the pool cannot satisfy the
-        request right now (the scheduler keeps it queued and decodes)."""
+        """``n`` block ids at refcount 1 each, or None when the pool
+        cannot satisfy the request right now (the scheduler keeps it
+        queued and decodes; prefix-cache eviction may free some)."""
         if n > len(self.free_blocks):
             return None
-        out = [self.free_blocks.popleft() for _ in range(int(n))]
+        out = []
+        for _ in range(int(n)):
+            b = self.free_blocks.popleft()
+            if self.refcounts[b] != 0:
+                raise BlockAccountingError(
+                    f"free list corrupt: block {b} popped with refcount "
+                    f"{self.refcounts[b]} (double-listed)")
+            self.refcounts[b] = 1
+            out.append(b)
         self.blocks_hwm = max(self.blocks_hwm, self.in_use)
         return out
 
+    def share(self, blocks: List[int]) -> None:
+        """Add one reference to each block — a new request mapping a
+        cached prefix, or the trie adopting a request's block."""
+        for b in blocks:
+            b = self._check(b)
+            if self.refcounts[b] == 0:
+                raise BlockAccountingError(
+                    f"cannot share block {b}: it is free (refcount 0) — "
+                    "a stale block id outlived its release")
+            self.refcounts[b] += 1
+
     def free(self, blocks: List[int]) -> None:
-        self.free_blocks.extend(blocks)
+        """Drop one reference per block; a block returns to the FIFO
+        free list only when its last reference is gone. Freeing an
+        already-free block raises (the double-free that used to corrupt
+        the list silently)."""
+        for b in blocks:
+            b = self._check(b)
+            if self.refcounts[b] == 0:
+                raise BlockAccountingError(
+                    f"double free of block {b}: refcount is already 0")
+            self.refcounts[b] -= 1
+            if self.refcounts[b] == 0:
+                self.free_blocks.append(b)
+
+    def leaked(self) -> List[int]:
+        """Blocks still referenced — the zero-leak churn tests assert
+        this is empty (or exactly the trie's retained set)."""
+        return [b for b in range(1, self.n_blocks) if self.refcounts[b]]
 
     def reset(self) -> None:
         """Forget every allocation (replica kill/rejoin: the pool arrays
         are rebuilt from zeros, so no block is live anymore)."""
         self.free_blocks = deque(range(1, self.n_blocks))
+        self.refcounts = [0] * self.n_blocks
 
 
 @dataclasses.dataclass
@@ -153,6 +226,11 @@ class Request:
     # serving telemetry (per-request): set by the engine
     submit_step: int = 0
     first_token_step: Optional[int] = None
+    # first-token wall stamp (scheduler clock, ms): TTFT = first_token_ms
+    # - submit_ms — THE head-of-line-blocking metric the chunked-prefill
+    # bench sub-leg reports (a short request behind a monolithic long
+    # prefill pays the whole prefill wall here)
+    first_token_ms: float = 0.0
     # sampling-stream tag: the engine keys each request's rng fold on this
     # (submission order) rather than the process-global ``rid`` counter, so
     # the same (prompts, seed) reproduces the same draws run after run
@@ -173,6 +251,31 @@ class Request:
     # occupies a slot (allocated at admission, freed on recycle) — empty
     # for ring-layout engines and while queued
     kv_blocks: List[int] = dataclasses.field(default_factory=list)
+    # prefix cache + chunked prefill (ISSUE 14, serving/prefix.py /
+    # docs/serving.md "Prefix cache & chunked prefill"):
+    # prefix_hit_tokens — tokens mapped from the radix trie at admission
+    # (their prefill compute is skipped); prefill_pos — tokens of the
+    # effective prompt whose KV is in the pool so far (starts at the
+    # hit, advances per chunk); prefill_target — the effective prompt
+    # length this admission must prefill; chunk_shape — the compiled
+    # chunk program's token width; pending_cow — (src, dst) block pair
+    # when the shared partial tail block must be cloned before the
+    # first suffix write (the copy-on-write path); finish_ms — terminal
+    # clock stamp (request-completion latency = finish_ms - submit_ms)
+    prefix_hit_tokens: int = 0
+    prefill_pos: int = 0
+    prefill_target: int = 0
+    chunk_shape: int = 0
+    pending_cow: Optional[Tuple[int, int]] = None
+    finish_ms: float = 0.0
+
+    @property
+    def prefilling(self) -> bool:
+        """True while this request occupies a slot whose prompt KV is
+        not fully in the pool yet — the decode batch excludes it (its
+        length cursor is unset; decode would read garbage)."""
+        return self.prefill_target > 0 and \
+            self.prefill_pos < self.prefill_target
 
     @property
     def prompt_len(self) -> int:
@@ -281,6 +384,18 @@ class ContinuousBatchScheduler:
         self.allocator: Optional[BlockAllocator] = None
         self.max_context: Optional[int] = None
         self.on_slot_freed = None
+        # prefix cache + chunked prefill (ISSUE 14): the paged engine
+        # attaches its radix-tree PrefixCache and --prefill-chunk-tokens
+        # here; admission walks the trie, maps the hit into the slot's
+        # block table and only the suffix is prefilled (in chunks when
+        # the suffix exceeds chunk_tokens). _chunk_turn alternates chunk
+        # and decode actions so a long prompt's chunks interleave with
+        # other slots' decode steps instead of stalling them.
+        self.prefix = None
+        self.chunk_tokens = 0
+        self._chunk_turn = False
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
         # hedge-loss cancellations (ISSUE 11): slots/queue entries freed
         # WITHOUT a terminal outcome — the winning twin owns the ledger
         self.cancelled = 0
@@ -341,40 +456,143 @@ class ContinuousBatchScheduler:
         self.queue_depth_hwm = max(self.queue_depth_hwm, len(self.queue))
 
     # ------------------------------------------------------------ scheduling
+    def _admit_head(self):
+        """Admit the head-of-queue request into a free slot with
+        prefix-aware block accounting (ISSUE 14). Returns the classic
+        ``("prefill", ...)`` action, the string ``"chunked"`` when the
+        request entered the chunk-prefill path (admission bookkeeping
+        only — action selection continues), or None when the pool cannot
+        hold it yet (admission waits; decode continues).
+
+        The trie walk maps the longest cached prefix (>= one full block)
+        into the new slot's block table with zero prefill compute; only
+        the suffix is prefilled. A hit whose boundary falls inside a
+        shared block schedules a copy-on-write clone (``pending_cow``):
+        the tail block is cloned into a freshly-allocated block before
+        the first divergent write, so the sharer's rows are never
+        perturbed."""
+        req = self.queue[0]
+        eff = req.effective_len
+        match_blocks: List[int] = []
+        match_t = 0
+        if self.allocator is not None:
+            alc = self.allocator
+            if self.prefix is not None:
+                # never match the full prompt: the final token's forward
+                # pass is what produces the next-token logits admission
+                # needs, so >= 1 token always prefills
+                match_blocks, match_t = self.prefix.match(
+                    req.current_prompt(), cap=eff - 1)
+            # worst-case extent: the ORIGINAL prompt + the total token
+            # cap (generated tokens count toward max_new_tokens, so a
+            # quarantine retry's committed tokens are already inside it)
+            need_total = alc.blocks_needed(
+                req.prompt_len + req.max_new_tokens)
+            partial = match_t % alc.block_size != 0
+            fresh_needed = need_total - len(match_blocks) + (1 if partial
+                                                            else 0)
+            if match_blocks:
+                # pin the matched nodes before any eviction can run
+                alc.share(match_blocks)
+            fresh = alc.alloc(fresh_needed)
+            if fresh is None and self.prefix is not None:
+                # pool pressure: evict LRU unreferenced trie nodes and
+                # retry — cached prefixes are a performance loan, never
+                # a reason to starve admission
+                if self.prefix.evict(fresh_needed - len(alc.free_blocks)):
+                    fresh = alc.alloc(fresh_needed)
+            if fresh is None:
+                if match_blocks:
+                    alc.free(match_blocks)  # drop the pins; stay queued
+                return None
+            if partial:
+                # the shared tail block will be cloned into fresh[0]
+                # before the first suffix write (engine-side donated
+                # jit); the share on src is held until the clone lands
+                req.pending_cow = (match_blocks[-1], fresh[0])
+                req.kv_blocks = match_blocks[:-1] + [fresh[0]] + fresh[1:]
+            else:
+                req.pending_cow = None
+                req.kv_blocks = match_blocks + fresh
+        req.prefix_hit_tokens = match_t
+        req.prefill_pos = match_t
+        req.prefill_target = eff
+        req.chunk_shape = 0
+        self.queue.popleft()
+        slot = self._free.popleft()
+        self.slots[slot] = req
+        self.admitted += 1
+        if match_t:
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += match_t
+        suffix = eff - match_t
+        if match_t > 0 or (self.chunk_tokens and
+                           suffix > self.chunk_tokens):
+            # chunk path: the suffix runs through the chunk-prefill
+            # program — chunk_tokens-wide steps when chunking is on, one
+            # bucket-shaped chunk otherwise. Compiled shape floor 2: a
+            # 1-row projection lowers as a matvec whose accumulation
+            # differs from the GEMM's by ~1 ulp (the same lowering fact
+            # behind ServingState.exact), breaking the cached-vs-cold
+            # bitwise contract.
+            req.chunk_shape = max(
+                2, self.chunk_tokens or bucket_for(suffix, self.buckets))
+            self._chunk_turn = True
+            return "chunked"
+        req.prefill_pos = 0  # classic one-shot: the engine marks
+        # completion (prefill_pos = target) only after the slot write
+        return ("prefill", req, slot, bucket_for(eff, self.buckets))
+
     def next_action(self):
         """("prefill", request, slot, bucket_len) when a request can be
         admitted into a free slot — prefill takes priority so freed
-        capacity never idles while work queues; else ("decode",
-        [(slot, request), ...]) over the in-flight slots; else None.
-        While ``draining`` (graceful SIGTERM shutdown) admission stops:
-        only decode actions are produced, so in-flight requests finish and
-        the queue is left intact for the engine to hand back."""
-        if self.queue and self._free and not self.draining:
-            req = self.queue[0]
-            blocks = None
-            if self.allocator is not None:
-                # whole-request up-front allocation: the slot's blocks
-                # cover prompt + max_new, so the decode loop never
-                # allocates. FIFO is preserved — when the HEAD request
-                # cannot get its blocks yet, admission waits (decode
-                # continues; recycling will free blocks)
-                blocks = self.allocator.alloc(self.allocator.blocks_needed(
-                    req.prompt_len + req.max_new_tokens))
-                if blocks is None:
-                    req = None
-            if req is not None:
-                self.queue.popleft()
-                if blocks is not None:
-                    req.kv_blocks = blocks
-                slot = self._free.popleft()
-                self.slots[slot] = req
-                self.admitted += 1
-                return ("prefill", req, slot,
-                        bucket_for(req.effective_len, self.buckets))
-        live = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        capacity never idles while work queues; ("prefill_chunk",
+        request, slot, start, n_tokens, chunk_shape) for one chunk of an
+        in-progress chunked/suffix prefill, alternating with ("decode",
+        [(slot, request), ...]) over the decodable in-flight slots so a
+        long prompt never head-of-line-blocks the continuous batch; else
+        None. While ``draining`` (graceful SIGTERM shutdown) admission
+        stops: in-progress prefills and decodes still run so in-flight
+        requests finish, and the queue is left intact for the engine to
+        hand back."""
+        while self.queue and self._free and not self.draining:
+            act = self._admit_head()
+            if act is None:
+                break  # pool pressure: decode on, recycling frees blocks
+            if act != "chunked":
+                return act
+        chunking = [(i, r) for i, r in enumerate(self.slots)
+                    if r is not None and r.prefilling]
+        live = [(i, r) for i, r in enumerate(self.slots)
+                if r is not None and not r.prefilling]
+        if chunking and (self._chunk_turn or not live):
+            slot, req = chunking[0]  # lowest slot — deterministic
+            self._chunk_turn = False  # a decode turn comes next
+            n = min(req.chunk_shape, req.prefill_target - req.prefill_pos)
+            return ("prefill_chunk", req, slot, req.prefill_pos, n,
+                    req.chunk_shape)
         if live:
+            self._chunk_turn = True
             return ("decode", live)
         return None
+
+    def chunk_done(self, slot: int, n_tokens: int) -> bool:
+        """Record one completed prefill chunk for the request in
+        ``slot``; returns True when its whole effective prompt is now in
+        the pool (the engine then samples the first token and arms the
+        slot for decode)."""
+        req = self.slots[slot]
+        assert req is not None, f"chunk for empty slot {slot}"
+        req.prefill_pos += int(n_tokens)
+        return req.prefill_pos >= req.prefill_target
+
+    def release_cow(self, req: Request) -> None:
+        """The engine's COW clone landed: drop the admission-held share
+        on the source block (the clone in the request's table owns the
+        divergent continuation now)."""
+        if req.pending_cow is not None and self.allocator is not None:
+            self.allocator.free([req.pending_cow[0]])
+        req.pending_cow = None
 
     def commit_token(self, slot: int, token: int) -> bool:
         """Record one generated token for the request in ``slot``; returns
@@ -389,13 +607,41 @@ class ContinuousBatchScheduler:
             return self._finish(slot, "length")
         return False
 
-    def _release_blocks(self, req: Request) -> None:
+    def _release_blocks(self, req: Request, adopt: bool = True) -> None:
         """The ONE choke point returning a request's pool blocks to the
         allocator — every slot-freeing path (finish, evict, quarantine,
         hedge cancellation) funnels through it so a block can never leak
-        or double-free."""
-        if self.allocator is not None and req.kv_blocks:
-            self.allocator.free(req.kv_blocks)
+        or double-free. ISSUE 14: prefix-trie retention ALSO happens
+        here — a fully-prefilled request's prompt blocks (including the
+        partial tail, the copy-on-write sharing site) are adopted into
+        the radix tree before the request's own references drop, so the
+        cached KV outlives the request and the next shared-prefix
+        admission pays no prefill. ``adopt=False`` on quarantine /
+        decode-fault paths: suspected-poisoned KV must never enter the
+        cache."""
+        if self.allocator is not None:
+            if req.pending_cow is not None:
+                # the COW clone never ran (released before the first
+                # suffix chunk): drop the admission-held source share
+                self.allocator.free([req.pending_cow[0]])
+                req.pending_cow = None
+            if req.kv_blocks:
+                if (adopt and self.prefix is not None
+                        and req.prefill_target > 0
+                        and req.prefill_pos >= req.prefill_target):
+                    self.prefix.insert(
+                        req.current_prompt()[:req.prefill_pos],
+                        req.kv_blocks)
+                elif not adopt and self.prefix is not None:
+                    # poison-suspect release: the decode poisoning NaN'd
+                    # this request's blocks IN PLACE — including any
+                    # prompt blocks the trie eagerly cached at prefill
+                    # completion. Purge them, or the victim's own retry
+                    # re-matches its poisoned prefix (never recovering)
+                    # and future shared-prefix admissions are served NaN
+                    # KV.
+                    self.prefix.invalidate(req.kv_blocks)
+                self.allocator.free(req.kv_blocks)
         req.kv_blocks = []
 
     def _finish(self, slot: int, reason: str,
@@ -404,7 +650,8 @@ class ContinuousBatchScheduler:
         req.done = True
         req.finish_reason = reason
         req.outcome = outcome
-        self._release_blocks(req)
+        req.finish_ms = float(self.clock())
+        self._release_blocks(req, adopt=outcome != "decode_fault")
         self.finished.append(req)
         self.slots[slot] = None
         self._free.append(slot)
@@ -438,6 +685,7 @@ class ContinuousBatchScheduler:
         req.done = True
         req.finish_reason = outcome
         req.outcome = outcome
+        req.finish_ms = float(self.clock())
         self._release_blocks(req)  # defensive: queued requests hold none
         self.finished.append(req)
 
@@ -450,7 +698,10 @@ class ContinuousBatchScheduler:
         tokens (``current_prompt`` re-prefills prompt + generated)."""
         req = self.slots[slot]
         assert req is not None, f"quarantine of empty slot {slot}"
-        self._release_blocks(req)  # the retry re-allocates at re-admission
+        # adopt=False: this slot's KV is poison-suspect — it must never
+        # enter the prefix cache (a poisoned trie would serve NaN KV to
+        # every future shared-prefix admission)
+        self._release_blocks(req, adopt=False)
         self.slots[slot] = None
         self._free.append(slot)
         self.quarantined += 1

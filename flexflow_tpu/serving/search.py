@@ -99,6 +99,9 @@ class ServingPlan:
     sim_memory: int
     feasible: bool
     kv_dtype: str = "native"
+    # expected prefill-token reuse fraction the p99 was priced at
+    # (ISSUE 14: measured prefix-cache hit rate, or an assumption)
+    prefill_reuse: float = 0.0
     assignment: Dict[int, object] = dataclasses.field(default_factory=dict)
     ranked: List[ServingCandidate] = dataclasses.field(default_factory=list)
     sim: object = None  # the warm Simulator (elastic re-search reuse)
@@ -281,7 +284,8 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                    sim=None, max_inflight: Optional[int] = None,
                    max_decode_len: Optional[int] = None,
                    slo_p99_ms: Optional[float] = None,
-                   kv_fill: float = 1.0) -> ServingPlan:
+                   kv_fill: float = 1.0,
+                   prefill_reuse: float = 0.0) -> ServingPlan:
     """Latency-bounded throughput search over (dp, tp, KV layout,
     kv_dtype) for the decode graph (kv_dtype ∈ {native, int8} is the
     ISSUE 12 precision-for-bandwidth axis; ``--kv-dtype`` pins it
@@ -289,7 +293,13 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     ranked runner-up chain; the warm Simulator rides along for elastic
     re-searches (``ServingEngine.elastic_replan``). ``kv_fill`` prices
     the decode KV read at a mean occupancy fraction (paged layout —
-    bench's simulated paged-vs-ring ratio)."""
+    bench's simulated paged-vs-ring ratio). ``prefill_reuse`` (ISSUE
+    14) prices the prefix cache the same honest way: the expected
+    fraction of prefill tokens served from the radix trie — measured
+    (``ServingStats.prefix_reuse_rate``, what ``elastic_replan``
+    feeds) or assumed — scales the p99 prefill stall term, so a
+    high-hit-rate fleet stops over-providing for a cold-cache worst
+    case the SLO never sees."""
     import time as _time
 
     from ..obs import SearchLog, get_tracer
@@ -320,6 +330,10 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     slog = SearchLog(getattr(config, "search_log_file", "") or None,
                      kind="serving")
     hbm = machine.hbm_capacity
+    # expected prefill savings from prefix reuse: a newly-admitted
+    # request stalls the batch for only the UNCACHED fraction of its
+    # prompt (zero-compute trie mapping covers the rest)
+    reuse = max(min(float(prefill_reuse), 1.0), 0.0)
     t0 = _time.perf_counter()
 
     def sweep(active_sim) -> List[Tuple[ServingCandidate, Dict]]:
@@ -339,7 +353,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
             if tp not in t_pre_by_tp:
                 t_pre_by_tp[tp], _pm, _a = _graph_cost(
                     active_sim, prefill_g, tp, 1, 1, max_len, decode=False)
-            t_pre = t_pre_by_tp[tp]
+            t_pre = t_pre_by_tp[tp] * (1.0 - reuse)
             layouts = ("sharded", "replicated") if tp > 1 else \
                 ("replicated",)
             for layout in layouts:
@@ -407,7 +421,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     plan = ServingPlan(
         mesh_shape=winner.mesh_shape, layout=winner.layout, slots=slots,
         max_decode_len=max_len, slo_p99_ms=slo,
-        kv_dtype=winner.kv_dtype,
+        kv_dtype=winner.kv_dtype, prefill_reuse=reuse,
         sim_decode_ms=winner.sim_decode_ms,
         sim_prefill_ms=winner.sim_prefill_ms,
         sim_p50_ms=winner.sim_p50_ms, sim_p99_ms=winner.sim_p99_ms,
@@ -417,6 +431,7 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
         ranked=[c for c, _a in ordered], sim=sim)
     slog.log(event="result", mesh=list(winner.mesh_shape),
              layout=winner.layout, kv_dtype=winner.kv_dtype,
+             prefill_reuse=round(reuse, 4),
              cost_ms=winner.sim_decode_ms, p99_ms=winner.sim_p99_ms,
              tokens_per_s=round(winner.sim_tokens_per_s, 2),
              mem_mib=round(winner.sim_memory / 2 ** 20, 1),

@@ -222,6 +222,21 @@ def summarize_telemetry(data, top: int) -> None:
 
     _block(data, "serving", _srv)
 
+    def _prefix(pf):
+        # prefix-cache headline (ISSUE 14): how much prefill the radix
+        # trie saved and how much chunked scheduling ran
+        rate = pf.get("reuse_rate", 0.0)
+        line = (f"prefix cache: reuse {round(100 * rate, 1)}% "
+                f"({pf.get('tokens_reused', 0)} tokens reused / "
+                f"{pf.get('tokens_computed', 0)} computed), "
+                f"{pf.get('hits', 0)} hits, "
+                f"chunked prefills {pf.get('chunked_prefills', 0)}")
+        if pf.get("evictions"):
+            line += f", evictions {pf['evictions']}"
+        print(line)
+
+    _block(data, "serving_prefix", _prefix)
+
     def _srvres(sr):
         # serving-under-failure headline (ISSUE 9): the outcome ledger of
         # the serve run — every request under exactly one outcome — and
@@ -259,6 +274,8 @@ def summarize_telemetry(data, top: int) -> None:
         line = f"  dispatches: {fl.get('dispatches', [])}"
         if fl.get("shed_rate"):
             line += f"   shed rate {fl['shed_rate']}"
+        if fl.get("affinity_hits"):
+            line += f"   affinity hits {fl['affinity_hits']}"
         print(line)
         if (fl.get("failovers") or fl.get("migrations")
                 or fl.get("hedges") or fl.get("circuit_opens")):
