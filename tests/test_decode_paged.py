@@ -296,9 +296,15 @@ def test_small_pool_decouples_occupancy_and_serializes(gpt2):
     out_small = eng_small.generate(PROMPTS, max_new_tokens=6)
     out_full = eng_full.generate(PROMPTS, max_new_tokens=6)
     assert out_small == out_full
-    assert eng_small.block_allocator.in_use == 0, "blocks leaked"
+    # ISSUE 14: finished prompts' blocks are retained by the prefix trie
+    # (that's the cache) — live accounting must equal exactly the trie's
+    # holdings, and dropping the trie must leave zero leaked blocks
+    for eng in (eng_small, eng_full):
+        assert eng.block_allocator.in_use == eng._prefix.n_blocks, \
+            "blocks leaked beyond the prefix trie's holdings"
+        eng._prefix.clear(free=True)
+        assert eng.block_allocator.in_use == 0, "blocks leaked"
     assert eng_small.block_allocator.blocks_hwm <= mb
-    assert eng_full.block_allocator.in_use == 0
 
 
 def test_request_larger_than_pool_refused_at_submit():
@@ -536,6 +542,10 @@ def test_freed_slot_clears_table_row_and_cursor(gpt2):
                          exact_decode=True, kv_block_size=8,
                          kv_pool_blocks=2 * mb + 1)
     assert eng2.generate(churn, max_new_tokens=7) == base
+    # in-use == the prefix trie's retained blocks (ISSUE 14), zero once
+    # the trie is dropped
+    assert eng2.block_allocator.in_use == eng2._prefix.n_blocks
+    eng2._prefix.clear(free=True)
     assert eng2.block_allocator.in_use == 0
 
 
