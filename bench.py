@@ -290,6 +290,12 @@ def main():
     # clearly-labeled smoke trajectory like the PR 10 legs
     with tracer.span("fleet_leg"):
         result.update(fleet_leg(on_tpu))
+    # both tiers (ISSUE 15): the hierarchical multi-pod search on the
+    # simulated 256/1024/4096-chip topologies — cost model only, so the
+    # leg is identical on CPU and TPU (multipod_simulated: true always;
+    # no tunnel owns 4096 chips)
+    with tracer.span("multipod_search_leg"):
+        result.update(multipod_search_leg())
     if not on_tpu:
         with tracer.span("mfu_bf16opt_sim_leg"):
             result.update(mfu_bf16opt_sim_leg())
@@ -1140,6 +1146,82 @@ def memory_pressure_search_leg() -> dict:
         out["memsearch_vs_dp_time"] = round(t_dp / res.sim_time, 3)
     except Exception as e:
         out["memsearch_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def multipod_search_leg() -> dict:
+    """Hierarchical multi-pod search scaling ladder (ISSUE 15,
+    docs/multipod.md): run the two-level DCN x ICI search for BERT-Large
+    on the pinned simulated 256/1024/4096-chip topologies (cost model
+    only — ``multipod_simulated: true`` on both tiers, like the PR 10
+    simulated legs) and record per size: search wall seconds,
+    candidates/s, the ICI sub-solution memo + op-cost cache hit rates,
+    and the searched-vs-naive dp x pods simulated step-time ratio (> 1
+    means the searched plan beats naive data parallelism over every
+    chip)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.search import multipod
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.unity import unity_search
+
+    out = {"multipod_simulated": True}
+    try:
+        for chips in sorted(multipod.SIMULATED_TOPOLOGIES):
+            # strong-scaling regime: one sample per chip — exactly where
+            # naive dp x pods drowns in its cross-pod gradient allreduce
+            # and the pod-level structure (pipeline cuts, tp-in-pod) pays
+            batch = max(256, chips)
+            config = FFConfig()
+            config.batch_size = batch
+            ff = FFModel(config)
+            cfg = BertConfig(batch_size=batch, seq_len=512, hidden=1024,
+                             num_heads=16, num_layers=24,
+                             intermediate=4096)
+            build_bert(ff, cfg)
+            pcg = ff.create_pcg()
+            machine = multipod.simulated_multipod_machine(chips)
+            sim = Simulator(machine)
+            sim.activation_el = 2  # bf16 activations, the validated model
+            t0 = time.perf_counter()
+            res = unity_search(pcg.copy(), config, chips, machine=machine,
+                               return_result=True, insert_ir_nodes=False,
+                               sim=sim)
+            wall = time.perf_counter() - t0
+            out[f"multipod_search_wall_s_{chips}"] = round(wall, 3)
+            if getattr(res, "candidates", 0) and wall > 0:
+                out[f"multipod_candidates_per_s_{chips}"] = round(
+                    res.candidates / wall, 2)
+            if getattr(res, "cache_stats", None):
+                out[f"multipod_cost_cache_hit_rate_{chips}"] = \
+                    res.cache_stats.get("cost_cache_hit_rate")
+            st = getattr(res, "multipod_stats", None) or {}
+            out[f"multipod_dcn_candidates_{chips}"] = \
+                st.get("dcn_candidates")
+            # the memo law (docs/multipod.md): composing DCN candidates
+            # over memoized ICI sub-solutions pays zero op_cost misses
+            out[f"multipod_dcn_enum_op_cost_misses_{chips}"] = \
+                st.get("dcn_enum_op_cost_misses")
+            t_naive = multipod.naive_dp_pods_time(pcg, sim, machine)
+            out[f"multipod_searched_vs_naive_{chips}"] = round(
+                t_naive / res.sim_time, 4) if res.sim_time else None
+            out[f"multipod_plan_{chips}"] = res.strategy.describe()
+            # warm re-search: the ICI sub-solution memo survives on the
+            # simulator, so a re-plan (elastic restart, drift re-rank)
+            # pays only the DCN level
+            t1 = time.perf_counter()
+            res2 = unity_search(pcg.copy(), config, chips,
+                                machine=machine, return_result=True,
+                                insert_ir_nodes=False, sim=sim)
+            out[f"multipod_warm_search_wall_s_{chips}"] = round(
+                time.perf_counter() - t1, 3)
+            st2 = getattr(res2, "multipod_stats", None) or {}
+            hits = st2.get("ici_memo_hits", 0) or 0
+            misses = st2.get("ici_memo_misses", 0) or 0
+            out[f"multipod_ici_memo_hit_rate_{chips}"] = round(
+                hits / (hits + misses), 4) if hits + misses else None
+    except Exception as e:
+        out["multipod_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

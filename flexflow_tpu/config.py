@@ -107,6 +107,17 @@ class FFConfig:
     # grad); "off" keeps the synchronous all-reduces at step end
     collective_overlap: str = "off"
 
+    # multi-pod topology + hierarchical search (docs/multipod.md;
+    # ISSUE 15). --pods N splits the machine into N DCN-connected pods
+    # (each one ICI domain; 0 = keep the detected/parsed topology);
+    # --dcn-gbps overrides the per-pod DCN bandwidth in GB/s
+    num_pods: int = 0
+    dcn_gbps: float = 0.0
+    # two-level DCN x ICI strategy search: "auto" (default — on for
+    # multi-pod machines at >= 64 chips), "on" (force the decomposition),
+    # "off" (always the flat factorization sweep)
+    search_hierarchical: str = "auto"
+
     # machine model for the simulator
     machine_model_version: int = 0
     machine_model_file: str = ""
@@ -404,6 +415,17 @@ class FFConfig:
                 self.import_strategy_file = _next()
             elif a == "--export" or a == "--export-strategy":
                 self.export_strategy_file = _next()
+            elif a == "--pods":
+                self.num_pods = int(_next())
+            elif a == "--dcn-gbps":
+                self.dcn_gbps = float(_next())
+            elif a == "--hierarchical-search":
+                v = _next()
+                if v not in ("auto", "on", "off"):
+                    raise ValueError(
+                        f"--hierarchical-search expects auto|on|off, "
+                        f"got {v!r}")
+                self.search_hierarchical = v
             elif a == "--machine-model-version":
                 self.machine_model_version = int(_next())
             elif a == "--machine-model-file":
@@ -697,6 +719,22 @@ class FFConfig:
                     "--virtual-stages only applies to the interleaved "
                     "schedule; add --schedule interleaved or drop "
                     "--virtual-stages")
+        if "--pods" in seen and self.num_pods < 1:
+            raise ValueError(
+                f"--pods must be >= 1 (got {self.num_pods}): it is the "
+                "number of DCN-connected ICI domains the machine is "
+                "split into (1 = single pod)")
+        if "--dcn-gbps" in seen and self.dcn_gbps <= 0:
+            raise ValueError(
+                f"--dcn-gbps must be > 0 (got {self.dcn_gbps}): it is "
+                "the per-pod cross-DCN bandwidth in GB/s the cost model "
+                "prices cross-pod collectives with")
+        if "--dcn-gbps" in seen and self.num_pods < 2 and \
+                not self.machine_model_file:
+            raise ValueError(
+                "--dcn-gbps needs a multi-pod topology to apply to: add "
+                "--pods N with N >= 2 (or a --machine-model-file with "
+                "num_pods)")
         if "--drift-tolerance" in seen and self.drift_tolerance <= 0:
             raise ValueError(
                 f"--drift-tolerance must be > 0 (got "

@@ -67,6 +67,12 @@ class Strategy:
     # build_hybrid_mesh so an axis's DCN factor never splits an ICI ring
     # (reference: inter- vs intra-node placement, simulator.h:212-606)
     hybrid: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
+    # pod-level assignment from the hierarchical multi-pod search
+    # (docs/multipod.md): (pod count, mode, grad accumulation factor)
+    # where mode is "dp" (FSDP-style cross-pod data parallel) or
+    # "pipeline" (pods as pipeline stages — the grid itself rides
+    # ``pipeline``/``schedule``). None = single-pod / flat-searched.
+    pods: Optional[Tuple[int, str, int]] = None
 
     def for_node(self, guid: int) -> NodeStrategy:
         return self.node_strategies.setdefault(guid, NodeStrategy())
@@ -87,6 +93,8 @@ class Strategy:
             bits.append(f"remat={self.remat}")
         if self.hybrid:
             bits.append(f"dcn={tuple(self.hybrid[1])}")
+        if self.pods:
+            bits.append(describe_pods(self.pods))
         return " ".join(bits)
 
     # -- serialization (reference: export_strategy_file) ------------------------
@@ -101,6 +109,7 @@ class Strategy:
             "remat": self.remat,
             "hybrid": [list(self.hybrid[0]), list(self.hybrid[1])]
             if self.hybrid else None,
+            "pods": list(self.pods) if self.pods else None,
             "nodes": {},
         }
         for guid, ns in self.node_strategies.items():
@@ -130,7 +139,10 @@ class Strategy:
                      virtual_stages=int(d.get("virtual_stages", 1) or 1),
                      remat=d.get("remat", "") or "",
                      hybrid=(tuple(d["hybrid"][0]), tuple(d["hybrid"][1]))
-                     if d.get("hybrid") else None)
+                     if d.get("hybrid") else None,
+                     pods=(int(d["pods"][0]), str(d["pods"][1]),
+                           int(d["pods"][2]))
+                     if d.get("pods") else None)
         by_name = {n.name: n.guid for n in pcg.topo_order()}
         for name, nd in d["nodes"].items():
             if name not in by_name:
@@ -150,6 +162,17 @@ class Strategy:
 
 def _despec(entries):
     return tuple(tuple(e) if isinstance(e, list) else e for e in entries)
+
+
+def describe_pods(pods: Tuple[int, str, int]) -> str:
+    """Compact pod-plan id ("pods=2:dp" / "pods=2:dp(ga=4)") shared by
+    Strategy.describe, RankedCandidate.describe and trace_summary — one
+    vocabulary for the pod-level assignment everywhere it prints."""
+    n, mode, ga = pods
+    s = f"pods={n}:{mode}"
+    if int(ga or 1) > 1:
+        s += f"(ga={ga})"
+    return s
 
 
 def data_parallel_strategy(pcg: PCG, num_devices: int,

@@ -111,6 +111,27 @@ def preflight_config(config) -> None:
         raise PreflightError(
             f"--calibrate-from-trace {trace!r}: no such profile file "
             "(produce one with --profile-ops)")
+    pods = int(getattr(config, "num_pods", 0) or 0)
+    if pods < 0:
+        raise PreflightError(
+            f"--pods must be >= 0 (got {pods}); 0 keeps the detected "
+            "topology, N >= 1 splits the machine into N DCN-connected "
+            "pods")
+    gbps = float(getattr(config, "dcn_gbps", 0.0) or 0.0)
+    if gbps < 0:
+        raise PreflightError(
+            f"--dcn-gbps must be >= 0 (got {gbps}); 0 keeps the "
+            "generation default, > 0 overrides the per-pod DCN "
+            "bandwidth in GB/s")
+    if gbps > 0 and pods < 2 and \
+            not getattr(config, "machine_model_file", ""):
+        raise PreflightError(
+            "--dcn-gbps needs a multi-pod topology to apply to: set "
+            "--pods N >= 2 (or a --machine-model-file with num_pods)")
+    hs = (getattr(config, "search_hierarchical", "auto") or "auto")
+    if hs not in ("auto", "on", "off"):
+        raise PreflightError(
+            f"--hierarchical-search expects auto|on|off, got {hs!r}")
 
 
 # --------------------------------------------------------------- strategy

@@ -52,6 +52,14 @@ class TPUMachineModel:
     # (EnhancedMachineModel, simulator.h:212-606; machine_config_example's
     # NIC vs NVLink rows).
     num_hosts: int = 1
+    # multi-pod topologies (docs/multipod.md): a POD is one ICI domain —
+    # the DCN island the hierarchical search's ICI level solves within.
+    # 0 = pods follow ``num_hosts`` (every DCN island is one pod, the
+    # single-level machines that predate the pod axis); >= 2 records an
+    # explicit pod count, which in this cost model IS the DCN split
+    # (``num_hosts`` is kept equal — one DCN level, priced by the
+    # hier_* closed forms below).
+    num_pods: int = 0
     generation: str = "v5e"
     peak_flops: float = 197e12  # bf16
     peak_flops_f32: float = 98.5e12
@@ -90,7 +98,14 @@ class TPUMachineModel:
 
     @staticmethod
     def from_file(path: str, num_chips: int = 1) -> "TPUMachineModel":
-        """v1: key = value lines (analog of machine_config_example)."""
+        """v1: key = value lines (analog of machine_config_example).
+
+        Multi-pod fields (docs/multipod.md): ``num_pods`` declares the
+        pod count (each pod one ICI domain; pods connected by DCN) and
+        ``dcn_bisection_gbps`` the per-pod DCN bandwidth in GB/s —
+        both validated at parse time with errors naming the bad field,
+        so a typo'd topology file fails before a 4096-chip search prices
+        a machine that doesn't exist."""
         kv: Dict[str, str] = {}
         with open(path) as f:
             for line in f:
@@ -98,11 +113,49 @@ class TPUMachineModel:
                 if "=" in line:
                     k, v = line.split("=", 1)
                     kv[k.strip()] = v.strip()
+
+        def _bad(field: str, why: str):
+            return ValueError(
+                f"machine model file {path}: field {field!r} = "
+                f"{kv[field]!r} is invalid: {why}")
+
+        num_pods = 0
+        if "num_pods" in kv:
+            try:
+                num_pods = int(kv["num_pods"])
+            except ValueError:
+                raise _bad("num_pods", "expected an integer pod count")
+            if num_pods < 1:
+                raise _bad("num_pods", "the machine needs >= 1 pod")
+            if num_chips % num_pods:
+                raise _bad(
+                    "num_pods",
+                    f"must divide num_chips={num_chips} — a pod is a "
+                    "whole ICI domain, chips cannot straddle pods")
         # num_hosts feeds the default-torus computation (invariant:
         # prod(torus) == chips per slice), so parse it BEFORE construction
         num_hosts = int(kv.get("num_hosts", 1))
+        if num_pods:
+            if "num_hosts" in kv and num_hosts != num_pods:
+                raise _bad(
+                    "num_pods",
+                    f"conflicts with num_hosts={num_hosts}: this cost "
+                    "model has ONE DCN level, so pods ARE the DCN "
+                    "islands — drop one field or make them equal")
+            num_hosts = num_pods
         m = TPUMachineModel.from_generation(kv.get("generation", "v5e"),
                                             num_chips, num_hosts=num_hosts)
+        m.num_pods = num_pods
+        if "dcn_bisection_gbps" in kv:
+            try:
+                gbps = float(kv["dcn_bisection_gbps"])
+            except ValueError:
+                raise _bad("dcn_bisection_gbps",
+                           "expected a number (GB/s per pod across DCN)")
+            if gbps <= 0:
+                raise _bad("dcn_bisection_gbps",
+                           "DCN bandwidth must be > 0 GB/s")
+            m.dcn_bandwidth = gbps * 1e9
         for field in ("peak_flops", "hbm_bandwidth", "ici_bandwidth",
                       "dcn_bandwidth", "ici_latency", "dcn_latency",
                       "matmul_efficiency", "hbm_efficiency",
@@ -114,6 +167,50 @@ class TPUMachineModel:
         if "torus" in kv:
             m.torus = tuple(int(x) for x in kv["torus"].split("x"))
         return m
+
+    @staticmethod
+    def multipod(generation: str, num_pods: int, chips_per_pod: int,
+                 dcn_gbps: float = 0.0) -> "TPUMachineModel":
+        """A simulated multi-pod machine: ``num_pods`` ICI domains of
+        ``chips_per_pod`` chips each, connected by DCN (cost model only —
+        the hierarchical search's regression topologies run on CPU)."""
+        if num_pods < 1:
+            raise ValueError(f"multipod: num_pods must be >= 1, got "
+                             f"{num_pods}")
+        if chips_per_pod < 1:
+            raise ValueError(f"multipod: chips_per_pod must be >= 1, got "
+                             f"{chips_per_pod}")
+        m = TPUMachineModel.from_generation(
+            generation, num_pods * chips_per_pod, num_hosts=num_pods)
+        m.num_pods = num_pods
+        if dcn_gbps:
+            if dcn_gbps <= 0:
+                raise ValueError(
+                    f"multipod: dcn_gbps must be > 0, got {dcn_gbps}")
+            m.dcn_bandwidth = dcn_gbps * 1e9
+        return m
+
+    def apply_pod_overrides(self, num_pods: int = 0,
+                            dcn_gbps: float = 0.0) -> "TPUMachineModel":
+        """Apply the ``--pods`` / ``--dcn-gbps`` CLI overrides onto a
+        constructed machine (unity_search's machine-from-config path)."""
+        if num_pods:
+            if num_pods < 1:
+                raise ValueError(
+                    f"--pods must be >= 1, got {num_pods}")
+            if self.num_chips % num_pods:
+                raise ValueError(
+                    f"--pods {num_pods} does not divide the machine's "
+                    f"{self.num_chips} chips — a pod is a whole ICI "
+                    "domain, chips cannot straddle pods")
+            self.set_num_hosts(num_pods)
+            self.num_pods = num_pods
+        if dcn_gbps:
+            if dcn_gbps <= 0:
+                raise ValueError(
+                    f"--dcn-gbps must be > 0, got {dcn_gbps}")
+            self.dcn_bandwidth = dcn_gbps * 1e9
+        return self
 
     def set_num_hosts(self, num_hosts: int) -> "TPUMachineModel":
         """Re-split the machine into ``num_hosts`` DCN-connected slices,
@@ -155,6 +252,17 @@ class TPUMachineModel:
     @property
     def chips_per_host(self) -> int:
         return max(self.num_chips // max(self.num_hosts, 1), 1)
+
+    @property
+    def pods(self) -> int:
+        """Pod count of the machine: the explicit ``num_pods`` when set,
+        else the host count (single-level machines: every DCN island is
+        one pod)."""
+        return max(self.num_pods or self.num_hosts, 1)
+
+    @property
+    def chips_per_pod(self) -> int:
+        return max(self.num_chips // self.pods, 1)
 
     # ---- communication cost primitives (α-β model over the torus) -----------
     # ``medium``: "ici" (within a slice) or "dcn" (across hosts). DCN is a

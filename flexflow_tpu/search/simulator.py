@@ -432,8 +432,13 @@ class Simulator:
                       if (k[0], k[1]) in op_keys]
         for k in stale_cost:
             del self._cost_cache[k]
+        # pod-level ICI sub-solutions (search/multipod.py) aggregate MANY
+        # ops' costs under one graph-hash key, so any recalibrated op may
+        # have moved any of them — drop them all (cheap: re-solving is a
+        # handful of DP passes, serving a stale pod plan is silent)
         stale_table = [k for k in self._table_cache
-                       if len(k) >= 3 and (k[1], k[2]) in op_keys]
+                       if (len(k) >= 3 and (k[1], k[2]) in op_keys)
+                       or (k and k[0] == "ici_pod_solution")]
         for k in stale_table:
             del self._table_cache[k]
         return {"cost_entries": len(stale_cost),

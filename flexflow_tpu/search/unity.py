@@ -121,6 +121,10 @@ class RankedCandidate:
     # chunk count — distinct schedules of one grid are distinct candidates
     schedule: str = ""
     virtual_stages: int = 1
+    # pod-level assignment of the hierarchical multi-pod search (ISSUE 15;
+    # docs/multipod.md): (pod count, "dp"|"pipeline", grad-accum factor),
+    # None for flat-searched / single-pod candidates
+    pods: Optional[Tuple[int, str, int]] = None
     strategy_json: Optional[str] = None
 
     def describe(self) -> str:
@@ -138,6 +142,10 @@ class RankedCandidate:
             bits.append(f"remat={self.remat}")
         if tuple(self.dcn) != (1, 1):
             bits.append(f"dcn={tuple(self.dcn)}")
+        if self.pods:
+            from ..parallel.strategy import describe_pods
+
+            bits.append(describe_pods(self.pods))
         return " ".join(bits)
 
 
@@ -171,6 +179,15 @@ class SearchResult:
     # candidates ShardLint rejected before simulation (ISSUE 7): free
     # rejections — none of these paid an op_cost/simulate call
     pruned_static: int = 0
+    # pod-level assignment from the hierarchical multi-pod search
+    # (ISSUE 15): (pod count, "dp"|"pipeline", grad-accum factor); the
+    # same triple is stamped on strategy.pods
+    pod_plan: Optional[Tuple[int, str, int]] = None
+    # hierarchical-search telemetry (docs/multipod.md): ICI sub-solution
+    # memo hits/misses, DCN candidates composed, op_cost misses during
+    # the DCN enumeration (the memo law's ground truth — must be 0),
+    # exactly-repriced candidate count
+    multipod_stats: Optional[Dict] = None
     # the WARM simulator that priced this search (ISSUE 8): the drift
     # sentinel's closed loop repairs THIS ruler in place (selective
     # delta-cost invalidation) and re-ranks `ranked` with its hot tables;
@@ -1406,16 +1423,17 @@ def _build_ranked(best: SearchResult,
                 (feas == cur[0] and t < cur[1]):
             entries[key] = (feas, t, mem, res, pre)
 
-    for (mesh, dcn, remat), (feas, r) in spmd_pool.items():
-        consider((mesh, dcn, remat, None), feas, r.sim_time, r.sim_memory,
-                 r, None)
+    for (mesh, dcn, remat, pods), (feas, r) in spmd_pool.items():
+        consider((mesh, dcn, remat, pods, None), feas, r.sim_time,
+                 r.sim_memory, r, None)
     for c in pipe_cands:
         # distinct schedules of one (grid, remat) are distinct fallback
         # candidates: a 1f1b plan that fails can degrade to its gpipe twin
-        consider((tuple(c.mesh_shape), tuple(c.dcn), c.remat,
+        consider((tuple(c.mesh_shape), tuple(c.dcn), c.remat, c.pods,
                   tuple(c.pipeline), c.schedule, c.virtual_stages),
                  c.feasible, c.sim_time, c.sim_memory, None, c)
 
+    win_pods = getattr(best, "pod_plan", None)
     win_pipe = (tuple(best.strategy.pipeline)
                 if getattr(best.strategy, "pipeline", None) else None)
     win_sched = (getattr(best.strategy, "schedule", "") or "gpipe") \
@@ -1424,15 +1442,17 @@ def _build_ranked(best: SearchResult,
         if win_pipe else 1
     if win_pipe:
         win_key: Tuple = (tuple(best.mesh_shape), tuple(best.dcn),
-                          best.remat, win_pipe, win_sched, win_v)
+                          best.remat, win_pods, win_pipe, win_sched,
+                          win_v)
     else:
         win_key = (tuple(best.mesh_shape), tuple(best.dcn), best.remat,
-                   None)
+                   win_pods, None)
     ranked = [RankedCandidate(
         mesh_shape=tuple(best.mesh_shape), dcn=tuple(best.dcn),
         remat=best.remat, sim_time=best.sim_time, sim_memory=best.sim_memory,
         feasible=bool(mem_budget is None or best.sim_memory <= mem_budget),
-        pipeline=win_pipe, schedule=win_sched, virtual_stages=win_v)]
+        pipeline=win_pipe, schedule=win_sched, virtual_stages=win_v,
+        pods=win_pods)]
     others = sorted(((key, v) for key, v in entries.items()
                      if key != win_key),
                     key=lambda kv: (not kv[1][0], kv[1][1], repr(kv[0])))
@@ -1444,7 +1464,7 @@ def _build_ranked(best: SearchResult,
         if res is not None and res.pcg is not None:
             sjson = res.strategy.to_json(res.pcg)
         ranked.append(RankedCandidate(
-            mesh_shape=key[0], dcn=key[1], remat=key[2],
+            mesh_shape=key[0], dcn=key[1], remat=key[2], pods=key[3],
             sim_time=t, sim_memory=mem, feasible=feas,
             strategy_json=sjson))
     return ranked
@@ -1477,6 +1497,11 @@ def unity_search(pcg: PCG, config, n_dev: int,
                                                n_dev)
         else:
             machine = TPUMachineModel.detect(n_dev)
+        # --pods / --dcn-gbps multi-pod overrides (docs/multipod.md);
+        # an explicitly passed machine is already the caller's topology
+        machine.apply_pod_overrides(
+            int(getattr(config, "num_pods", 0) or 0),
+            float(getattr(config, "dcn_gbps", 0.0) or 0.0))
     if sim is None:
         from .calibration import dtype_label
 
@@ -1582,83 +1607,116 @@ def unity_search(pcg: PCG, config, n_dev: int,
     pruned_static = [0]
     pruned_keys: set = set()
 
+    # hierarchical multi-pod decomposition (ISSUE 15, docs/multipod.md):
+    # when the machine spans pods and the scale warrants it (or
+    # --hierarchical-search on), the SPMD sweep runs the two-level
+    # DCN x ICI search instead of the flat enumeration; the pod-local
+    # sub-solution memo and its counters live on the solver
+    from . import multipod
+
+    use_hier = multipod.hierarchical_enabled(config, machine, n_dev)
+    hier_solver = multipod.ICISubSolver(sim) if use_hier else None
+    hier_stats: Dict = {}
+
     def pool_consider(r: SearchResult) -> None:
         feas = rank_budget is None or r.sim_memory <= rank_budget
-        key = (tuple(r.mesh_shape), tuple(r.dcn), r.remat)
+        key = (tuple(r.mesh_shape), tuple(r.dcn), r.remat,
+               getattr(r, "pod_plan", None))
         cur = ranked_pool.get(key)
         if cur is None or (feas and not cur[0]) or \
                 (feas == cur[0] and r.sim_time < cur[1].sim_time):
             ranked_pool[key] = (feas, r)
 
-    def search_all(lam: float, mem_budget: Optional[int] = None
+    def search_all(lam: float, mem_budget: Optional[int] = None,
+                   hierarchical: Optional[bool] = None
                    ) -> Optional[SearchResult]:
         """One sweep over factorizations at a fixed λ. With a memory budget,
         the best FEASIBLE candidate by time wins (falling back to minimum
-        memory — reference: is_valid_strategy, graph.cc:1984-2032)."""
+        memory — reference: is_valid_strategy, graph.cc:1984-2032). On a
+        multi-pod machine the sweep dispatches to the two-level
+        hierarchical decomposition (multipod.hierarchical_sweep)."""
+        if hierarchical is None:
+            hierarchical = use_hier
+        if hierarchical:
+            return multipod.hierarchical_sweep(
+                base_pcg, sim, machine, n_dev, batch, lam, mem_budget,
+                space, remat_levels, xfers, budget, alpha,
+                protected_guids,
+                getattr(config, "base_optimize_threshold", 0), slog,
+                hier_solver, static_on, pool_consider, hier_stats)
         results: List[SearchResult] = []
         # per-sweep log state: `accepted` must mirror THIS sweep's actual
         # selection rule (feasibility included) — a global best across λ
         # sweeps would mislabel a sweep's real winner as rejected
         sweep_best = [float("inf")]
-        for dp, tp in factorizations(n_dev):
-            if batch % dp != 0:
-                continue
-            for dp_dcn, tp_dcn in dcn_placements(dp, tp, machine.num_hosts):
-                sim.set_axis_topology(dp_dcn, tp_dcn)
-                for remat in remat_levels:
-                    g, a, s, t = best_first_optimize(
-                        base_pcg, sim, dp, tp, batch, xfers,
-                        budget=max(budget // 4, 4), alpha=alpha, space=space,
-                        lam=lam, protected_guids=protected_guids,
-                        split_threshold=getattr(config,
-                                                "base_optimize_threshold",
-                                                0),
-                        search_log=slog, remat=remat)
-                    strat = assignment_to_strategy(
-                        g, a, s, dp, tp, machine=machine,
-                        dcn=(dp_dcn, tp_dcn))
-                    strat.remat = remat
-                    if static_on:
-                        rep = analyze_candidate(g, strat)
-                        if rep.errors:
-                            key = (dp, tp, dp_dcn, tp_dcn, remat)
-                            if key not in pruned_keys:
-                                pruned_keys.add(key)
-                                pruned_static[0] += 1
-                                slog.log(
-                                    event="pruned_static", dp=dp, tp=tp,
-                                    dcn=[dp_dcn, tp_dcn],
-                                    lam=round(lam, 4), remat=remat,
-                                    rules=rep.rules_fired(),
-                                    first=rep.errors[0]
-                                    .format_line()[:300])
-                            continue
-                    _, mem = sim.simulate(g, a, s)
-                    _log.info(
-                        "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f remat=%s -> "
-                        "%.3f ms, %.1f MiB/chip", dp, tp, dp_dcn, tp_dcn,
-                        lam, remat, t * 1e3, mem / 2 ** 20)
-                    feasible = mem_budget is None or mem <= mem_budget
-                    accepted = feasible and t < sweep_best[0]
-                    if accepted:
-                        sweep_best[0] = t
-                    slog.log(event="candidate", dp=dp, tp=tp,
-                             dcn=[dp_dcn, tp_dcn], lam=round(lam, 4),
-                             remat=remat,
-                             cost_ms=round(t * 1e3, 4),
-                             mem_mib=round(mem / 2 ** 20, 1),
-                             feasible=bool(feasible),
-                             accepted=bool(accepted),
-                             best_ms=round(
-                                 (sweep_best[0]
-                                  if sweep_best[0] != float("inf")
-                                  else t) * 1e3, 4))
-                    results.append(SearchResult(
-                        strategy=strat,
-                        assignment=a, sim_time=t, sim_memory=mem,
-                        mesh_shape=(dp, tp), pcg=g, states=s,
-                        dcn=(dp_dcn, tp_dcn), remat=remat))
-        sim.set_axis_topology(1, 1)
+        # restore under try/finally: an exception mid-sweep (a raising
+        # cost model, a broken rewrite) must not leak a candidate's DCN
+        # topology into a warm shared simulator (ISSUE 15 satellite)
+        saved_topo = (sim.dp_dcn, sim.tp_dcn)
+        try:
+            for dp, tp in factorizations(n_dev):
+                if batch % dp != 0:
+                    continue
+                for dp_dcn, tp_dcn in dcn_placements(dp, tp,
+                                                     machine.num_hosts):
+                    sim.set_axis_topology(dp_dcn, tp_dcn)
+                    for remat in remat_levels:
+                        g, a, s, t = best_first_optimize(
+                            base_pcg, sim, dp, tp, batch, xfers,
+                            budget=max(budget // 4, 4), alpha=alpha,
+                            space=space,
+                            lam=lam, protected_guids=protected_guids,
+                            split_threshold=getattr(
+                                config, "base_optimize_threshold", 0),
+                            search_log=slog, remat=remat)
+                        strat = assignment_to_strategy(
+                            g, a, s, dp, tp, machine=machine,
+                            dcn=(dp_dcn, tp_dcn))
+                        strat.remat = remat
+                        if static_on:
+                            rep = analyze_candidate(g, strat)
+                            if rep.errors:
+                                key = (dp, tp, dp_dcn, tp_dcn, remat)
+                                if key not in pruned_keys:
+                                    pruned_keys.add(key)
+                                    pruned_static[0] += 1
+                                    slog.log(
+                                        event="pruned_static", dp=dp,
+                                        tp=tp,
+                                        dcn=[dp_dcn, tp_dcn],
+                                        lam=round(lam, 4), remat=remat,
+                                        rules=rep.rules_fired(),
+                                        first=rep.errors[0]
+                                        .format_line()[:300])
+                                continue
+                        _, mem = sim.simulate(g, a, s)
+                        _log.info(
+                            "mesh dp=%d tp=%d dcn=(%d,%d) lam=%.2f "
+                            "remat=%s -> %.3f ms, %.1f MiB/chip", dp, tp,
+                            dp_dcn, tp_dcn,
+                            lam, remat, t * 1e3, mem / 2 ** 20)
+                        feasible = mem_budget is None or mem <= mem_budget
+                        accepted = feasible and t < sweep_best[0]
+                        if accepted:
+                            sweep_best[0] = t
+                        slog.log(event="candidate", dp=dp, tp=tp,
+                                 dcn=[dp_dcn, tp_dcn], lam=round(lam, 4),
+                                 remat=remat,
+                                 cost_ms=round(t * 1e3, 4),
+                                 mem_mib=round(mem / 2 ** 20, 1),
+                                 feasible=bool(feasible),
+                                 accepted=bool(accepted),
+                                 best_ms=round(
+                                     (sweep_best[0]
+                                      if sweep_best[0] != float("inf")
+                                      else t) * 1e3, 4))
+                        results.append(SearchResult(
+                            strategy=strat,
+                            assignment=a, sim_time=t, sim_memory=mem,
+                            mesh_shape=(dp, tp), pcg=g, states=s,
+                            dcn=(dp_dcn, tp_dcn), remat=remat))
+        finally:
+            sim.set_axis_topology(*saved_topo)
         for r in results:
             pool_consider(r)
         if not results:
@@ -1690,6 +1748,28 @@ def unity_search(pcg: PCG, config, n_dev: int,
     with _log.scope("unity_search n_dev=%d" % n_dev), \
             tracer.span("search", n_dev=n_dev):
         best = search_all(lam=1.0)
+        if use_hier and selfcheck_enabled() and \
+                n_dev <= multipod.SELFCHECK_MAX_DEV:
+            # two-level vs flat equivalence gate (docs/multipod.md): on a
+            # mesh small enough to enumerate both ways, the hierarchical
+            # winner must be the flat search_all winner. The shadow flat
+            # sweep must VERIFY, not perturb: snapshot/restore the ranked
+            # pool, prune dedup and event counters so selfcheck-on runs
+            # rank and report identically to selfcheck-off runs
+            pool_snap = dict(ranked_pool)
+            counts_snap = dict(slog.counts)
+            pruned_snap = (pruned_static[0], set(pruned_keys))
+            try:
+                flat_best = search_all(lam=1.0, hierarchical=False)
+            finally:
+                ranked_pool.clear()
+                ranked_pool.update(pool_snap)
+                slog.counts.clear()
+                slog.counts.update(counts_snap)
+                pruned_static[0] = pruned_snap[0]
+                pruned_keys.clear()
+                pruned_keys.update(pruned_snap[1])
+            multipod.assert_selfcheck_matches_flat(best, flat_best)
         # memory-aware λ binary search (reference: graph.cc:2060-2133):
         # find the largest λ (most runtime-weighted) whose best strategy
         # still fits per-chip HBM
@@ -1736,7 +1816,13 @@ def unity_search(pcg: PCG, config, n_dev: int,
             forced_sched = (getattr(config, "schedule", "") or "").strip()
             forced_v = int(getattr(config, "pipeline_virtual_stages", 0)
                            or 0)
-            for pp in (2, 4, 8):
+            # pod-aligned grids on a hierarchical multi-pod machine (pods
+            # as pipeline stages — the DCN-level pipeline axis, with the
+            # schedule per cut searched below); the classic (2, 4, 8)
+            # sweep otherwise
+            pipe_pods = ((machine.pods, "pipeline", 1)
+                         if use_hier else None)
+            for pp in multipod.pipeline_grids(n_dev, machine, use_hier):
                 if n_dev % pp != 0 or pp > min(n_nodes, n_dev) or pp < 2:
                     continue
                 pdp = n_dev // pp
@@ -1806,7 +1892,8 @@ def unity_search(pcg: PCG, config, n_dev: int,
                             sim_time=t_pipe, sim_memory=m_pipe,
                             feasible=bool(feas),
                             pipeline=(pp, pdp, micro),
-                            schedule=sched, virtual_stages=sv))
+                            schedule=sched, virtual_stages=sv,
+                            pods=pipe_pods))
                         slog.log(event="pipeline_candidate", pp=pp,
                                  dp=pdp, n_micro=micro, remat=lv,
                                  schedule=sched, virtual_stages=sv,
@@ -1825,18 +1912,21 @@ def unity_search(pcg: PCG, config, n_dev: int,
                             strat.schedule = sched
                             strat.virtual_stages = sv
                             strat.remat = lv
+                            strat.pods = pipe_pods
                             best = SearchResult(
                                 strategy=strat, assignment={},
                                 sim_time=t_pipe, sim_memory=m_pipe,
                                 mesh_shape=(n_dev, 1), pcg=None,
-                                states=None, remat=lv)
+                                states=None, remat=lv,
+                                pod_plan=pipe_pods)
 
     # delta-cost engine telemetry: wall time, throughput and cache counters
     # land on the SearchResult (bench.py's search_wall_s metric) and in the
     # final SearchLog record
     search_wall_s = time.perf_counter() - t_search0
     candidates = sum(slog.counts.get(k, 0) for k in
-                     ("candidate", "xfer", "pipeline_candidate"))
+                     ("candidate", "xfer", "pipeline_candidate",
+                      "dcn_candidate"))
     d_hits = sim.cost_cache_hits - cache0[0]
     d_misses = sim.cost_cache_misses - cache0[1]
     cache_stats = {
@@ -1852,6 +1942,11 @@ def unity_search(pcg: PCG, config, n_dev: int,
         best.candidates = candidates
         best.cache_stats = cache_stats
         best.pruned_static = pruned_static[0]
+        if use_hier:
+            if hier_solver is not None:
+                pruned_static[0] += hier_solver.pruned_static
+                best.pruned_static = pruned_static[0]
+            best.multipod_stats = dict(hier_stats)
         # ranked fallback chain (ISSUE 5): persisted on the result AND in
         # the search log, so the compile-time cascade (and a post-mortem of
         # one) can replay which plans were next in line
@@ -1863,6 +1958,7 @@ def unity_search(pcg: PCG, config, n_dev: int,
              "pipeline": list(c.pipeline) if c.pipeline else None,
              "schedule": c.schedule or None,
              "virtual_stages": c.virtual_stages,
+             "pods": list(c.pods) if c.pods else None,
              "cost_ms": round(c.sim_time * 1e3, 4),
              "mem_mib": round(c.sim_memory / 2 ** 20, 1),
              "feasible": bool(c.feasible)}
@@ -1876,11 +1972,14 @@ def unity_search(pcg: PCG, config, n_dev: int,
                  schedule=(getattr(best.strategy, "schedule", "") or None),
                  virtual_stages=int(
                      getattr(best.strategy, "virtual_stages", 1) or 1),
+                 pods=(list(best.pod_plan) if best.pod_plan else None),
                  search_wall_s=round(search_wall_s, 4),
                  candidates=candidates,
                  candidates_per_s=round(candidates / search_wall_s, 2)
                  if search_wall_s > 0 else None,
                  pruned_static=pruned_static[0],
+                 **(dict(best.multipod_stats)
+                    if best.multipod_stats else {}),
                  **cache_stats)
     slog.close()
     if best is None:
@@ -1894,10 +1993,14 @@ def unity_search(pcg: PCG, config, n_dev: int,
         pcg._order = best.pcg._order
     if insert_ir_nodes and best.states is not None:
         dp, tp = best.mesh_shape
-        sim.set_axis_topology(*best.dcn)  # annotate at the winner's topology
-        insert_parallel_ops(pcg, best.assignment, best.states, best.strategy,
-                            sim, dp, tp)
-        sim.set_axis_topology(1, 1)
+        try:
+            # annotate at the winner's topology; restore even when an
+            # insertion fails so a warm shared simulator stays clean
+            sim.set_axis_topology(*best.dcn)
+            insert_parallel_ops(pcg, best.assignment, best.states,
+                                best.strategy, sim, dp, tp)
+        finally:
+            sim.set_axis_topology(1, 1)
     best.sim = sim
     return (best if return_result else best.strategy)
 

@@ -18,7 +18,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+# standalone invocation (python scripts/trace_summary.py ...): the repo
+# root is not on sys.path, and the searched-plan line imports the
+# schedule/pod describe helpers from the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def load(path: str):
@@ -335,6 +343,14 @@ def summarize_jsonl(records, top: int) -> None:
                         int(r.get("virtual_stages", 1) or 1))
                     bits.append(f"schedule={sched or 'gpipe'}")
                 bits.append(f"remat={r.get('remat', 'none')}")
+                if r.get("pods"):
+                    # pod-level assignment of the hierarchical multi-pod
+                    # search (ISSUE 15): pods=N:mode(ga=...), same
+                    # vocabulary as Strategy.describe
+                    from flexflow_tpu.parallel.strategy import \
+                        describe_pods
+
+                    bits.append(describe_pods(tuple(r["pods"])))
                 print("searched plan: " + "  ".join(bits))
             if r.get("search_wall_s") is not None:
                 # delta-cost engine headline: throughput + cache hit rate
