@@ -573,6 +573,12 @@ def serving_leg() -> dict:
         eng.generate(prompts, max_new_tokens=64)
         st = eng.stats
         out["serving_tokens_per_s"] = round(st.tokens_per_s(), 1)
+        # host-overhead split (ISSUE 16): fraction of serve wall the host
+        # spent dispatching + bookkeeping vs blocked on the device — the
+        # ROADMAP "host overhead" baseline
+        hof = st.host_overhead_fraction()
+        if hof is not None:
+            out["serving_host_overhead_fraction"] = round(hof, 4)
         p50, p99 = st.p50_token_ms(), st.p99_token_ms()
         if p50 is not None:
             out["serving_p50_token_ms"] = round(p50, 3)
@@ -902,6 +908,9 @@ def fleet_leg(on_tpu) -> dict:
                            kill_replica_at={kill_tick: 0}))
         st = fleet.stats
         out["fleet_tokens_per_s"] = round(st.tokens_per_s(), 1)
+        hof = st.host_overhead_fraction()
+        if hof is not None:
+            out["fleet_host_overhead_fraction"] = round(hof, 4)
         out["fleet_occupancy"] = round(
             st.occupancy(fleet.total_slots()), 3)
         walls = []

@@ -945,7 +945,16 @@ class Executor:
                               kv_dtype=str(kv_dtype))
             ctx = OpContext(training=False, rng=None, mesh=mesh,
                             profiling=profiling, serving=sv)
+            # pad rows (beyond n_new) can place past the position table
+            # when start + chunk_len overhangs the context (a trie-hit
+            # suffix chunk admitted deep into the prompt): jnp.take's
+            # fill mode turns that gather into NaN embeddings, the pad
+            # rows' NaN k/v land in the garbage block, and the gathered
+            # extent's softmax-zero x NaN poisons the REAL rows. Clamp
+            # pads to the chunk's last real position — real rows are
+            # untouched, pads stay finite, garbage stays finite.
             pos = (start + jnp.arange(chunk_len, dtype=jnp.int32))[None, :]
+            pos = jnp.minimum(pos, start + n_new - 1)
             values = self.forward_outputs(
                 params, self._bind_inputs(xs), ctx,
                 overrides=self._serving_overrides(pos_guids, pos))

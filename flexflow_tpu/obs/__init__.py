@@ -9,6 +9,12 @@ analog, SURVEY §1 L0):
 * ``telemetry``: per-step training telemetry (wall times, loss history,
   compile-vs-steady split, samples/sec, estimated MFU, XLA peak memory) and
   the Unity/MCMC per-iteration search log.
+* ``reqtrace``: request-level distributed tracing for the serving stack
+  (ISSUE 16) — per-request lifecycle timelines finalized into a versioned
+  ``RequestRecord`` JSONL stream + Perfetto spans, plus the fleet's
+  per-tick ``FleetTimeSeries`` ring buffers. Disabled by default via the
+  same no-op-singleton idiom — ``enable_reqtrace()`` swaps in a live
+  recorder.
 * xprof passthroughs: ``start_server`` / ``start_trace`` / ``stop_trace`` /
   ``trace`` wrap ``jax.profiler`` so per-op ``jax.named_scope`` annotations
   (Executor.forward_outputs) show up in XLA/xprof traces.
@@ -18,6 +24,9 @@ host-side and gated on ``get_tracer().enabled``.
 """
 from .trace import (NoopTracer, Tracer, atomic_write_json,  # noqa: F401
                     disable, enable, get_tracer, set_tracer)
+from .reqtrace import (FleetTimeSeries, NoopRequestTrace,  # noqa: F401
+                       RequestTrace, disable_reqtrace, enable_reqtrace,
+                       get_reqtrace, set_reqtrace)
 from .telemetry import (SearchLog, StepTelemetry,  # noqa: F401
                         capture_memory_analysis, detect_peak_flops,
                         model_flops_per_step)

@@ -130,6 +130,10 @@ class StepTelemetry:
         self.serving_p50_token_ms: Optional[float] = None
         self.serving_p99_token_ms: Optional[float] = None
         self.serving_tokens_per_s: Optional[float] = None
+        # host-overhead split (ISSUE 16): fraction of serve-loop wall the
+        # HOST spent dispatching + bookkeeping (vs blocked on the device)
+        # — the ROADMAP "host overhead" baseline, per engine and fleet
+        self.serving_host_overhead_fraction: Optional[float] = None
         # serving-resilience counters (ISSUE 9): the outcome ledger of a
         # serve() run (every request under exactly one of ok |
         # deadline_exceeded | shed | decode_fault | preempted) plus the
@@ -170,6 +174,7 @@ class StepTelemetry:
         self.fleet_circuit_opens: int = 0
         self.fleet_failovers: int = 0
         self.fleet_health_transitions: int = 0
+        self.fleet_host_overhead_fraction: Optional[float] = None
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -304,6 +309,9 @@ class StepTelemetry:
                 sv["p50_token_ms"] = round(self.serving_p50_token_ms, 3)
             if self.serving_p99_token_ms is not None:
                 sv["p99_token_ms"] = round(self.serving_p99_token_ms, 3)
+            if self.serving_host_overhead_fraction is not None:
+                sv["host_overhead_fraction"] = round(
+                    self.serving_host_overhead_fraction, 4)
             out["serving"] = sv
         if self.fleet_replicas:
             total = max(sum(self.fleet_outcomes.values()), 1)
@@ -324,6 +332,9 @@ class StepTelemetry:
                 "failovers": self.fleet_failovers,
                 "health_transitions": self.fleet_health_transitions,
             }
+            if self.fleet_host_overhead_fraction is not None:
+                fl["host_overhead_fraction"] = round(
+                    self.fleet_host_overhead_fraction, 4)
             out["fleet"] = fl
         if (self.serving_prefix_hits or self.serving_prefix_tokens_reused
                 or self.serving_prefill_tokens_computed

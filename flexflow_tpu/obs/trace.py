@@ -70,6 +70,13 @@ class NoopTracer:
     def complete(self, name: str, wall_s: float, **args) -> None:
         pass
 
+    def span_at(self, name: str, ts_us: float, dur_us: float,
+                tid=None, **args) -> None:
+        pass
+
+    def event_at(self, name: str, ts_us: float, tid=None, **args) -> None:
+        pass
+
     def counter(self, name: str, value) -> None:
         pass
 
@@ -202,6 +209,29 @@ class Tracer:
                     "ts": round(max(end - wall_s * 1e6, 0.0), 3),
                     "dur": round(wall_s * 1e6, 3), "pid": self.pid,
                     "tid": threading.get_ident(), "args": args})
+
+    def span_at(self, name: str, ts_us: float, dur_us: float,
+                tid=None, **args) -> None:
+        """Complete ('X') event at an EXPLICIT timestamp (µs). The
+        request tracer (obs/reqtrace.py) uses this to export spans on
+        the scheduler's injectable clock — deterministic under a fake
+        clock — instead of the tracer's own perf_counter epoch; such
+        spans carry their own time base (one pid lane per source), so
+        nesting is judged within a lane, never across lanes."""
+        self._emit({"name": name, "cat": "flexflow", "ph": "X",
+                    "ts": round(float(ts_us), 3),
+                    "dur": round(max(float(dur_us), 0.0), 3),
+                    "pid": self.pid,
+                    "tid": threading.get_ident() if tid is None else tid,
+                    "args": args})
+
+    def event_at(self, name: str, ts_us: float, tid=None, **args) -> None:
+        """Instant ('i') event at an explicit timestamp (µs) — the
+        ``event()`` analog of :meth:`span_at`."""
+        self._emit({"name": name, "cat": "flexflow", "ph": "i", "s": "t",
+                    "ts": round(float(ts_us), 3), "pid": self.pid,
+                    "tid": threading.get_ident() if tid is None else tid,
+                    "args": args})
 
     def counter(self, name: str, value) -> None:
         self._emit({"name": name, "cat": "flexflow", "ph": "C",

@@ -110,6 +110,17 @@ class ServingStats:
     spec_rounds: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # host-overhead accounting (ISSUE 16, ROADMAP item 5): each tick's
+    # wall splits into dispatch (tick start -> device call issued: action
+    # selection, admission, chaos hooks), device (the blocking
+    # prefill/chunk/decode call + result fetch), and bookkeeping (commit
+    # loop, stats, trie inserts). host_overhead_fraction() is THE
+    # measured baseline the async host runtime must beat — always-on
+    # plain-float accumulation, it never touches the token streams
+    host_dispatch_s: float = 0.0
+    host_device_s: float = 0.0
+    host_bookkeep_s: float = 0.0
+    host_ticks: int = 0
 
     def record_token(self, wall_s: float) -> None:
         self.token_walls_s.append(wall_s)
@@ -148,6 +159,16 @@ class ServingStats:
         denom = self.decode_steps * n_slots
         return max(self.tokens_generated - self.prefills, 0) / denom \
             if denom else 0.0
+
+    def host_overhead_fraction(self) -> Optional[float]:
+        """Fraction of the serve loop's tick wall spent on the host
+        (dispatch + bookkeeping) rather than waiting on the device —
+        ROADMAP item 5's headline number. None before any tick ran."""
+        total = self.host_dispatch_s + self.host_device_s + \
+            self.host_bookkeep_s
+        if total <= 0.0:
+            return None
+        return (self.host_dispatch_s + self.host_bookkeep_s) / total
 
     def p50_token_ms(self) -> Optional[float]:
         if not self.token_walls_s:
@@ -196,6 +217,9 @@ class ServingStats:
         reuse = self.prefix_reuse_rate()
         if reuse:
             out["prefix_reuse_rate"] = round(reuse, 4)
+        hof = self.host_overhead_fraction()
+        if hof is not None:
+            out["host_overhead_fraction"] = round(hof, 4)
         return out
 
 
@@ -1157,6 +1181,8 @@ class ServingEngine:
         tel.serving_p50_token_ms = stats.p50_token_ms()
         tel.serving_p99_token_ms = stats.p99_token_ms()
         tel.serving_tokens_per_s = round(stats.tokens_per_s(), 2)
+        # host-overhead accounting (ISSUE 16, ROADMAP item 5)
+        tel.serving_host_overhead_fraction = stats.host_overhead_fraction()
         # serving_resilience block (ISSUE 9): the outcome ledger + event
         # counters, mirroring the resilience/strategy_safety blocks
         tel.serving_outcomes = dict(stats.outcomes)
@@ -1322,10 +1348,25 @@ class _ServeLoop:
                               grace_s=res.drain_grace_s)
 
     # ----------------------------------------------------------------- tick
+    def _acct_tick(self, t_tick: float, t_dev: float,
+                   dev_s: float) -> None:
+        """Host-overhead accounting (ISSUE 16, ROADMAP item 5): split
+        this tick's wall into dispatch (tick entry -> device call
+        issued), device (the blocking call + fetch) and bookkeeping
+        (device return -> now). Plain float adds — always on, never
+        touches the token streams."""
+        st = self.stats
+        st.host_dispatch_s += max(t_dev - t_tick, 0.0)
+        st.host_device_s += dev_s
+        st.host_bookkeep_s += max(
+            time.perf_counter() - t_dev - dev_s, 0.0)
+        st.host_ticks += 1
+
     def tick(self) -> bool:
         """Perform ONE scheduler action. Returns False when there is
         nothing to do right now (queue empty + no live slot, or the
         drain grace just expired and evicted the stragglers)."""
+        t_tick = time.perf_counter()
         import jax
         import jax.numpy as jnp
 
@@ -1380,9 +1421,11 @@ class _ServeLoop:
             stats.prefill_tokens_computed += eff
             stats.record_token(wall)
             stats.tokens_generated += 1
+            # first_token_ms is stamped at the commit point
+            # (ContinuousBatchScheduler.commit_token) — the one stamp
+            # site every first-commit path passes through
             if req.first_token_step is None:
                 req.first_token_step = self.step_no
-                req.first_token_ms = float(res.clock())
             if tracer.enabled:
                 tracer.complete("prefill", wall, rid=req.rid,
                                 bucket=bucket, slot=slot, prompt_len=eff)
@@ -1401,6 +1444,7 @@ class _ServeLoop:
                     if full:
                         eng._prefix.insert(cur[:full * eng.kv_block_size],
                                            req.kv_blocks[:full])
+            self._acct_tick(t_tick, t_p, wall)
             return True
         if action[0] == "prefill_chunk":
             # chunked prefill / prefix-suffix prefill (ISSUE 14): one
@@ -1443,7 +1487,12 @@ class _ServeLoop:
                 tracer.complete("prefill_chunk", wall, rid=req.rid,
                                 slot=slot, start=start, tokens=n,
                                 hit=req.prefix_hit_tokens, done=done)
+            if sched.rt.enabled:
+                sched.rt.note(req.rid, "chunk", float(res.clock()),
+                              start=start, tokens=n,
+                              replica=sched.replica_idx)
             if not done:
+                self._acct_tick(t_tick, t_p, wall)
                 return True
             eff = req.prefill_target
             tag = req.rng_tag if req.rng_tag is not None else req.rid
@@ -1454,9 +1503,9 @@ class _ServeLoop:
             stats.prefills += 1
             stats.record_token(self._chunk_walls.pop(req.rid, wall))
             stats.tokens_generated += 1
+            # first_token_ms lands at the commit point (commit_token)
             if req.first_token_step is None:
                 req.first_token_step = self.step_no
-                req.first_token_ms = float(res.clock())
             if eng._prefix is not None and req.kv_blocks:
                 full = eff // eng.kv_block_size
                 if full:
@@ -1470,6 +1519,7 @@ class _ServeLoop:
                 # discarded tokens into the garbage block, never into
                 # its real blocks)
                 eng._set_slot_meta(slot, eff, tok, row)
+            self._acct_tick(t_tick, t_p, wall)
             return True
         # decode: one token for every live slot. Sampling covers ALL
         # slots (free ones with a dummy rng, their draws discarded) so
@@ -1536,6 +1586,7 @@ class _ServeLoop:
             if tracer.enabled:
                 tracer.event("serving_state_rebuild", step=k,
                              requeued=requeued)
+            self._acct_tick(t_tick, t_d, 0.0)
             return True
         live_map = dict(live)
         # per-slot rng streams depend on (submission tag, tokens
@@ -1575,6 +1626,7 @@ class _ServeLoop:
         if tracer.enabled:
             tracer.complete("decode_step", wall, step=self.step_no,
                             live_slots=len(live))
+        self._acct_tick(t_tick, t_d, wall)
         return True
 
     # --------------------------------------------------------------- finish

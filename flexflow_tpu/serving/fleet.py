@@ -74,6 +74,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.reqtrace import FleetTimeSeries, get_reqtrace
 from .engine import ServingEngine, _ServeLoop
 from .resilience import AdmissionController, OverloadError
 from .scheduler import (ContinuousBatchScheduler, QueueFullError, Request,
@@ -171,6 +172,10 @@ class FleetReplica:
         # stats of retired serve loops (drain/rejoin rebuilds the loop)
         self.retired_tokens = 0
         self.retired_decode_steps = 0
+        # host-overhead seconds of retired loops: [dispatch, device,
+        # bookkeep] (ISSUE 16) — the fleet roll-up must not lose the
+        # wall split of a loop a drain/rejoin rebuilt
+        self.retired_host = [0.0, 0.0, 0.0]
 
     @property
     def alive(self) -> bool:
@@ -256,6 +261,13 @@ class FleetStats:
     kill_ticks: List[int] = dataclasses.field(default_factory=list)
     # tokens committed per fleet tick — the failover-recovery series
     tokens_history: List[int] = dataclasses.field(default_factory=list)
+    # host-overhead accounting (ISSUE 16, ROADMAP item 5): the replica
+    # loops' dispatch/device/bookkeeping splits summed at _finish, plus
+    # the router's own host work (dispatch, probes, hedges) in
+    # host_dispatch_s — ROADMAP item 5's fleet-level baseline
+    host_dispatch_s: float = 0.0
+    host_device_s: float = 0.0
+    host_bookkeep_s: float = 0.0
 
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         if n:
@@ -288,6 +300,15 @@ class FleetStats:
                 return t - kill_tick
         return None
 
+    def host_overhead_fraction(self) -> Optional[float]:
+        """Fleet-wide fraction of serve wall spent on the host rather
+        than waiting on devices (ServingStats analog; ISSUE 16)."""
+        total = self.host_dispatch_s + self.host_device_s + \
+            self.host_bookkeep_s
+        if total <= 0.0:
+            return None
+        return (self.host_dispatch_s + self.host_bookkeep_s) / total
+
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "replicas": self.replicas,
@@ -298,6 +319,9 @@ class FleetStats:
             "tokens_per_s": round(self.tokens_per_s(), 2),
             "dispatches": list(self.dispatches),
         }
+        hof = self.host_overhead_fraction()
+        if hof is not None:
+            out["host_overhead_fraction"] = round(hof, 4)
         if self.outcomes:
             out["outcomes"] = dict(self.outcomes)
         for k in ("sheds", "migrations", "requeued", "failovers", "hedges",
@@ -446,6 +470,11 @@ class ServingFleet:
         self._running = False
         self._serve_args: Dict[str, Any] = {}
         self._tick_tokens = 0
+        # ISSUE 16: fleet time-series ring buffers (created lazily in
+        # run() when request tracing is live, or attached by a caller)
+        # and the router's own host-time outside replica ticks
+        self.timeseries: Optional[FleetTimeSeries] = None
+        self._host_router_s = 0.0
 
     # ------------------------------------------------------------- obs hooks
     def _tracer(self):
@@ -515,6 +544,14 @@ class ServingFleet:
         # dispatch preserves this stamp across sched.submit's re-stamp)
         if not req.submit_ms:
             req.submit_ms = float(self.clock())
+        rt = get_reqtrace()
+        if rt.enabled:
+            # the timeline opens at the FLEET door (a later replica
+            # sched.submit adds a second "submit" note = re-queue edge)
+            rt.note(req.rid, "submit", req.submit_ms,
+                    prompt_len=req.prompt_len,
+                    max_new=req.max_new_tokens,
+                    deadline_ms=req.deadline_ms, replica=None)
         healthy = self._healthy()
         policy = self.shed_policy
         total_queued = self._total_queued()
@@ -523,6 +560,10 @@ class ServingFleet:
             if total_queued >= highwater:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                if rt.enabled:
+                    rt.finish(req.rid, float(self.clock()), "shed",
+                              policy="queue", queued=total_queued,
+                              highwater=highwater)
                 raise OverloadError(
                     f"request {req.rid} shed at the fleet door (policy "
                     f"'queue'): aggregate queue depth {total_queued} >= "
@@ -543,6 +584,12 @@ class ServingFleet:
             if est > req.deadline_ms:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                if rt.enabled:
+                    # the PRICED estimate that made the decision rides
+                    # on the terminal record — sheds are explainable
+                    rt.finish(req.rid, float(self.clock()), "shed",
+                              policy="deadline", est_ms=round(est, 3),
+                              deadline_ms=req.deadline_ms)
                 raise OverloadError(
                     f"request {req.rid} shed at the fleet door (policy "
                     f"'deadline'): estimated completion {est:.1f} ms "
@@ -553,6 +600,9 @@ class ServingFleet:
         if total_queued >= self.max_queue:
             self.stats.sheds += 1
             req.outcome = "shed"
+            if rt.enabled:
+                rt.finish(req.rid, float(self.clock()), "shed",
+                          policy="hard_wall", queued=total_queued)
             raise QueueFullError(
                 f"fleet queue full ({total_queued} waiting across "
                 f"{self.n_replicas} replicas, shed policy "
@@ -572,11 +622,15 @@ class ServingFleet:
             # cumulative counters before dropping it
             rep.retired_tokens += rep.loop.stats.tokens_generated
             rep.retired_decode_steps += rep.loop.stats.decode_steps
+            rep.retired_host[0] += rep.loop.stats.host_dispatch_s
+            rep.retired_host[1] += rep.loop.stats.host_device_s
+            rep.retired_host[2] += rep.loop.stats.host_bookkeep_s
         eng = rep.engine
         sched = ContinuousBatchScheduler(
             n_slots=eng.n_slots, max_queue=eng.max_queue,
             buckets=eng.buckets, max_len=eng.max_decode_len,
             clock=eng.resilience_clock or self.clock)
+        sched.replica_idx = rep.idx  # request-trace notes carry the domain
         rep.sched = sched
         a = self._serve_args
         rep.loop = eng.start_serve(
@@ -667,11 +721,17 @@ class ServingFleet:
         while every circuit is open must not be served seconds past its
         deadline with zero misses recorded."""
         now = self.clock()
+        rt = get_reqtrace()
         expired = [r for r in self.queue if r.expired(now)]
         for req in expired:
             remove_by_identity(self.queue, req)
             req.outcome = "deadline_exceeded"
             req.done = True
+            if rt.enabled:
+                # dropped at the door, never reaches a scheduler _finish
+                rt.finish(req.rid, float(now), "deadline_exceeded",
+                          reason="door_expired",
+                          new_tokens=len(req.generated))
         while self.queue:
             targets = [r for r in self.replicas
                        if self._dispatchable(r) and r.sched is not None
@@ -729,6 +789,10 @@ class ServingFleet:
                 # it either; one request must never crash the fleet
                 req.outcome = "preempted"
                 req.done = True
+                if rt.enabled:
+                    rt.finish(req.rid, float(self.clock()), "preempted",
+                              reason="unadmittable",
+                              new_tokens=len(req.generated))
                 continue
             if prior_submit:
                 req.submit_ms = prior_submit
@@ -828,6 +892,15 @@ class ServingFleet:
                 inflight.append(req)
         queued = list(sched.queue)
         sched.queue.clear()
+        rt = get_reqtrace()
+        if rt.enabled:
+            ts = float(self.clock())
+            for req in inflight:
+                rt.note(req.rid, "migrate", ts, src=rep.idx,
+                        tick=self.tick_no, inflight=True)
+            for req in queued:
+                rt.note(req.rid, "migrate", ts, src=rep.idx,
+                        tick=self.tick_no, inflight=False)
         return inflight, queued
 
     def _kill(self, rep: FleetReplica, reason: str) -> None:
@@ -923,6 +996,16 @@ class ServingFleet:
                     primary_replica=rep.idx, twin_replica=target.idx))
                 self._hedged_ids.add(id(req))
                 self.stats.hedges += 1
+                rt = get_reqtrace()
+                if rt.enabled:
+                    # fold the twin's timeline into the primary's: the
+                    # twin's submit note (just emitted) moves over, and
+                    # every later note on either copy lands on ONE
+                    # connected per-request timeline
+                    rt.link(twin.rid, req.rid)
+                    rt.note(req.rid, "hedge", float(now), src=rep.idx,
+                            replica=target.idx,
+                            fork=len(req.generated))
                 tracer = self._tracer()
                 if tracer.enabled:
                     tracer.event("fleet_hedge", rid=req.rid,
@@ -1004,10 +1087,17 @@ class ServingFleet:
             if h.mirrored:
                 continue
             h.primary.generated = list(h.twin.generated)
+            # the latency stamps must migrate with the tokens: an adopted
+            # twin's TTFT / completion times ARE the request's real
+            # latencies — without them the caller's primary reports
+            # first_token_ms/finish_ms of 0 and bench TTFT goes negative
+            if h.twin.first_token_ms and not h.primary.first_token_ms:
+                h.primary.first_token_ms = h.twin.first_token_ms
             if h.twin.done:
                 h.primary.done = True
                 h.primary.finish_reason = h.twin.finish_reason
                 h.primary.outcome = h.twin.outcome
+                h.primary.finish_ms = h.twin.finish_ms
                 h.mirrored = True
 
     # ----------------------------------------------------------------- chaos
@@ -1141,8 +1231,11 @@ class ServingFleet:
         session.install_signal_handlers()
         t0 = time.perf_counter()
         idle = 0
+        if get_reqtrace().enabled and self.timeseries is None:
+            self.timeseries = FleetTimeSeries()
         try:
             while True:
+                t_iter = time.perf_counter()
                 if chaos is not None:
                     self._apply_chaos(chaos)
                 self._run_probes()
@@ -1160,13 +1253,28 @@ class ServingFleet:
                 self._dispatch()
                 self._tick_tokens = 0
                 worked = False
+                # router host time = loop wall OUTSIDE replica ticks
+                # (chaos/probes/dispatch above, hedge machinery below);
+                # the per-replica serve loops split their own tick wall
+                self._host_router_s += time.perf_counter() - t_iter
                 for rep in self.replicas:
                     worked = self._tick_replica(rep) or worked
+                t_post = time.perf_counter()
                 self._resolve_hedges()
                 self._mirror_adopted()
                 self._launch_hedges()
                 self.stats.tokens_history.append(self._tick_tokens)
+                if self.timeseries is not None:
+                    self.timeseries.sample(
+                        self.tick_no, len(self.queue), self._tick_tokens,
+                        sum(r.drain_estimate_ms() for r in self.replicas
+                            if r.alive),
+                        [(r.sched.active / max(r.engine.n_slots, 1))
+                         if (r.alive and r.sched is not None) else 0.0
+                         for r in self.replicas],
+                        [r.health for r in self.replicas])
                 self.tick_no += 1
+                self._host_router_s += time.perf_counter() - t_post
                 if worked:
                     idle = 0
                     continue
@@ -1217,9 +1325,34 @@ class ServingFleet:
         # request under exactly one outcome; hedge twins are internal
         # and never counted (their winner's entry lives on the primary)
         st.outcomes = {}
+        rt = get_reqtrace()
         for req in self._requests:
             outcome = req.outcome or ("ok" if req.done else "preempted")
             st.count_outcome(outcome)
+            if rt.enabled:
+                # finalize is idempotent (first terminal note wins):
+                # requests the schedulers already finished drop this; only
+                # paths with no scheduler _finish — door leftovers,
+                # streams stranded on a dead/partitioned replica — close
+                # their timeline here, mirroring the ledger's outcome
+                rt.finish(req.rid, float(self.clock()), outcome,
+                          reason=req.finish_reason or outcome,
+                          new_tokens=len(req.generated))
+        # host-overhead roll-up: every replica serve loop's wall split
+        # (live + retired across drain/rejoin rebuilds) plus the
+        # router's own chaos/probe/dispatch/hedge time
+        st.host_dispatch_s = self._host_router_s
+        st.host_device_s = 0.0
+        st.host_bookkeep_s = 0.0
+        for rep in self.replicas:
+            d, v, b = rep.retired_host
+            if rep.loop is not None:
+                d += rep.loop.stats.host_dispatch_s
+                v += rep.loop.stats.host_device_s
+                b += rep.loop.stats.host_bookkeep_s
+            st.host_dispatch_s += d
+            st.host_device_s += v
+            st.host_bookkeep_s += b
         self._merge_telemetry(st)
         tracer = self._tracer()
         if tracer.enabled and self.model.config.trace_file:
@@ -1253,6 +1386,7 @@ class ServingFleet:
         tel.fleet_circuit_opens = st.circuit_opens
         tel.fleet_failovers = st.failovers
         tel.fleet_health_transitions = len(st.health_transitions)
+        tel.fleet_host_overhead_fraction = st.host_overhead_fraction()
         tel.finalize()
         if self.model.config.telemetry_file:
             tel.write(self.model.config.telemetry_file)

@@ -300,6 +300,14 @@ class ServingResilience:
             if sched.queued >= highwater:
                 self.sheds += 1
                 req.outcome = "shed"
+                if sched.rt.enabled:
+                    # record the decision WITH what priced it: queue
+                    # depth vs the high-water mark (ISSUE 16)
+                    sched.rt.finish(req.rid, float(self.clock()),
+                                    "shed", policy="queue",
+                                    queued=sched.queued,
+                                    highwater=highwater,
+                                    replica=sched.replica_idx)
                 raise OverloadError(
                     f"request {req.rid} shed (policy 'queue'): queue depth "
                     f"{sched.queued} >= high-water {highwater} "
@@ -312,6 +320,14 @@ class ServingResilience:
             if est > req.deadline_ms:
                 self.sheds += 1
                 req.outcome = "shed"
+                if sched.rt.enabled:
+                    # the priced estimate that MADE the decision rides
+                    # on the terminal record (ISSUE 16)
+                    sched.rt.finish(req.rid, float(self.clock()),
+                                    "shed", policy="deadline",
+                                    est_ms=round(est, 3),
+                                    deadline_ms=req.deadline_ms,
+                                    replica=sched.replica_idx)
                 raise OverloadError(
                     f"request {req.rid} shed (policy 'deadline'): "
                     f"estimated completion {est:.1f} ms exceeds deadline "
@@ -327,4 +343,9 @@ class ServingResilience:
             # outcome instead of vanishing from the accounting
             self.sheds += 1
             req.outcome = "shed"
+            if sched.rt.enabled:
+                sched.rt.finish(req.rid, float(self.clock()), "shed",
+                                policy="hard_wall",
+                                queued=sched.queued,
+                                replica=sched.replica_idx)
             raise

@@ -24,6 +24,8 @@ from typing import Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.reqtrace import get_reqtrace
+
 _req_counter = itertools.count(1)
 
 
@@ -370,6 +372,13 @@ class ContinuousBatchScheduler:
         self.clock = clock if clock is not None else now_ms
         self.shed_policy = "off"
         self.draining = False
+        # request-level tracing (ISSUE 16, obs/reqtrace.py): captured at
+        # construction like the engine's tracer; every lifecycle edge
+        # below notes the singleton behind an ``enabled`` guard (one
+        # attribute load + truth test when tracing is off). The fleet
+        # stamps its replica index here so cross-replica hops carry it.
+        self.rt = get_reqtrace()
+        self.replica_idx: Optional[int] = None
         self.quarantined = 0
         self.evicted = 0
         # paged KV (ISSUE 12): the engine attaches its BlockAllocator and
@@ -454,6 +463,12 @@ class ContinuousBatchScheduler:
         req.submit_ms = float(self.clock())
         self.queue.append(req)
         self.queue_depth_hwm = max(self.queue_depth_hwm, len(self.queue))
+        if self.rt.enabled:
+            self.rt.note(req.rid, "submit", req.submit_ms,
+                         prompt_len=req.prompt_len,
+                         max_new=req.max_new_tokens,
+                         deadline_ms=req.deadline_ms,
+                         replica=self.replica_idx)
 
     # ------------------------------------------------------------ scheduling
     def _admit_head(self):
@@ -522,6 +537,11 @@ class ContinuousBatchScheduler:
         slot = self._free.popleft()
         self.slots[slot] = req
         self.admitted += 1
+        if self.rt.enabled:
+            self.rt.note(req.rid, "admit", float(self.clock()),
+                         slot=slot, hit=match_t,
+                         cow=req.pending_cow is not None,
+                         replica=self.replica_idx)
         if match_t:
             self.prefix_hits += 1
             self.prefix_tokens_reused += match_t
@@ -601,6 +621,18 @@ class ContinuousBatchScheduler:
         req = self.slots[slot]
         assert req is not None, f"decode token for empty slot {slot}"
         req.generated.append(int(token))
+        # the first-token (TTFT) stamp lands HERE, at the commit point —
+        # not in the engine's prefill branches. Any admission path that
+        # commits its first token without a classic prefill step (a
+        # zero-prefill full-prefix hit, a hedge twin resuming a copied
+        # stream, a decode-path first commit) still gets stamped; a
+        # migrated request keeps the stamp from its original commit.
+        if not req.first_token_ms:
+            req.first_token_ms = float(self.clock())
+        if self.rt.enabled:
+            self.rt.note(req.rid, "token", float(self.clock()),
+                         occ=self.n_slots - len(self._free),
+                         replica=self.replica_idx)
         if req.eos_id is not None and int(token) == int(req.eos_id):
             return self._finish(slot, "eos")
         if len(req.generated) >= req.max_new_tokens:
@@ -651,6 +683,11 @@ class ContinuousBatchScheduler:
         req.finish_reason = reason
         req.outcome = outcome
         req.finish_ms = float(self.clock())
+        if self.rt.enabled:
+            self.rt.finish(req.rid, req.finish_ms, outcome,
+                           reason=reason,
+                           new_tokens=len(req.generated),
+                           replica=self.replica_idx)
         self._release_blocks(req, adopt=outcome != "decode_fault")
         self.finished.append(req)
         self.slots[slot] = None
@@ -686,6 +723,11 @@ class ContinuousBatchScheduler:
         req.finish_reason = outcome
         req.outcome = outcome
         req.finish_ms = float(self.clock())
+        if self.rt.enabled:
+            self.rt.finish(req.rid, req.finish_ms, outcome,
+                           reason=outcome,
+                           new_tokens=len(req.generated),
+                           replica=self.replica_idx)
         self._release_blocks(req)  # defensive: queued requests hold none
         self.finished.append(req)
 
@@ -705,6 +747,9 @@ class ContinuousBatchScheduler:
         self.slots[slot] = None
         self._free.append(slot)
         self.quarantined += 1
+        if self.rt.enabled:
+            self.rt.note(req.rid, "quarantine", float(self.clock()),
+                         slot=slot, replica=self.replica_idx)
         self.queue.appendleft(req)
         if self.on_slot_freed is not None:
             self.on_slot_freed(slot)

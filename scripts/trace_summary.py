@@ -305,7 +305,71 @@ def summarize_telemetry(data, top: int) -> None:
     _block(data, "loss_history", _loss)
 
 
+def _pctl(vals, q):
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
+
+
+def _request_digest(reqs) -> None:
+    """Per-request latency decomposition (ISSUE 16): p50/p99 of the
+    queue/prefill/decode/stall phase split per outcome class, replica
+    hop counts, prefix reuse and hedge volume — the RequestRecord JSONL
+    stream (obs/reqtrace.py, docs/observability.md) in ten lines."""
+    vs = {r.get("v") for r in reqs}
+    if vs - {1}:
+        # newer/older schema: show what we can, say what we skipped
+        print(f"note: request records carry schema version(s) "
+              f"{sorted(v for v in vs if v != 1)}; fields this summary "
+              "does not know are ignored")
+    by_outcome = {}
+    for r in reqs:
+        by_outcome.setdefault(r.get("outcome") or "?", []).append(r)
+    print(f"request trace: {len(reqs)} requests")
+    print(f"  {'outcome':18s} {'n':>5s}"
+          + "".join(f" {p + '_p50':>11s} {p + '_p99':>11s}"
+                    for p in ("queue", "prefill", "decode", "stall")))
+    for outcome, rs in sorted(by_outcome.items(),
+                              key=lambda kv: -len(kv[1])):
+        row = f"  {outcome:18s} {len(rs):5d}"
+        for p in ("queue", "prefill", "decode", "stall"):
+            vals = [float(r.get(p + "_ms") or 0.0) for r in rs]
+            row += f" {_pctl(vals, .5):11.2f} {_pctl(vals, .99):11.2f}"
+        print(row)
+    hops = sum(len(r.get("hops") or ()) for r in reqs)
+    multi = sum(1 for r in reqs if len(r.get("replicas") or ()) > 1)
+    hedged = sum(1 for r in reqs if r.get("hedged"))
+    reused = sum(int(r.get("prefix_hit_tokens") or 0) for r in reqs)
+    print(f"  hops: {hops} ({multi} requests touched >1 replica)   "
+          f"hedged: {hedged}   prefix tokens reused: {reused}")
+    ttfts = [float(r["first_token_ms"]) - float(r["arrival_ms"])
+             for r in reqs
+             if r.get("first_token_ms") and r.get("arrival_ms") is not None]
+    if ttfts:
+        print(f"  TTFT p50/p99: {_pctl(ttfts, .5):.2f}/"
+              f"{_pctl(ttfts, .99):.2f} ms")
+    dropped = sum(int(r.get("dropped_notes") or 0) for r in reqs)
+    if dropped:
+        print(f"  WARNING: {dropped} trace notes dropped "
+              "(per-request cap hit — timelines above are truncated)")
+
+
 def summarize_jsonl(records, top: int) -> None:
+    # RequestRecord streams (obs/reqtrace.py) route to their own digest;
+    # mixed sinks fall through to the generic aggregation for the rest
+    reqs = [r for r in records if r.get("kind") == "request"]
+    if reqs:
+        try:
+            _request_digest(reqs)
+        except (TypeError, KeyError, ValueError, IndexError,
+                AttributeError):
+            print("note: request records do not match this summary's "
+                  "schema (file from another PR?) — skipped")
+        records = [r for r in records if r.get("kind") != "request"]
+        if not records:
+            return
+        print()
     # search logs carry cost_ms; generic event sinks aggregate by name.
     # "result"/"sweep_result" records are summaries, not iterations — keep
     # them out of the iteration count / accept rate / trajectory

@@ -1,0 +1,125 @@
+"""Round-16 housekeeping (ISSUE 16 satellites):
+
+* ``scripts/check_trace_events.py`` — every tracer event/span name
+  emitted anywhere in ``flexflow_tpu/`` must appear in the event table
+  of ``docs/observability.md``; event/doc drift fails tier-1 here.
+* the checker extracts multi-line call sites, the reqtrace phase-span
+  map, and the pinned dynamic (f-string) names — and the negative
+  cases: an undocumented name fails, whole-token matching does not let
+  ``prefill`` satisfy ``prefill_chunk``, and a stale dynamic pin fails
+  loudly instead of silently shrinking coverage.
+* the telemetry ``serving`` / ``fleet`` blocks carry
+  ``host_overhead_fraction`` when the accounting ran, and omit it when
+  it didn't (zero-overhead absence, the serving_prefix idiom).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_trace_events  # noqa: E402
+
+
+def test_all_trace_events_documented(capsys):
+    """The live repo state: zero undocumented event/span names."""
+    assert check_trace_events.main([]) == 0
+    assert "ok: all" in capsys.readouterr().out
+
+
+def test_checker_extracts_known_names():
+    names, stale = check_trace_events.emitted_names(
+        os.path.join(REPO, "flexflow_tpu"))
+    assert not stale
+    # representative families: span, multi-line event, complete,
+    # counter, request-trace span_at/event_at, phase-map values,
+    # dynamic f-string pins
+    for n in ("compile", "train_step", "calibration_drift", "recovery",
+              "throughput_samples_per_sec", "prefill_chunk",
+              "decode_quarantine", "fleet_hedge", "request", "req_queue",
+              "req_prefill", "req_decode", "req_stall", "req_hop",
+              "req_shed", "req_outcome", "unity_iter", "mcmc_iter",
+              "op_profile"):
+        assert n in names, n
+
+
+def test_checker_fails_on_undocumented_name(tmp_path, capsys):
+    doc = tmp_path / "doc.md"
+    doc.write_text("only `compile` is documented here\n")
+    rc = check_trace_events.main(
+        [os.path.join(REPO, "flexflow_tpu"), str(doc)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "train_step" in err and "undocumented" in err
+
+
+def test_whole_token_matching(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        'def f(tracer):\n'
+        '    tracer.event("prefill")\n'
+        '    tracer.event(\n'
+        '        "late_span", x=1)\n')
+    doc = tmp_path / "doc.md"
+    # `prefill_chunk` must NOT satisfy `prefill`; the multi-line call
+    # site must be extracted
+    doc.write_text("`prefill_chunk` and `late_span` are documented\n")
+    # dynamic pins are repo-wide markers; this synthetic package has
+    # none, so neutralize them for the unit check
+    old = check_trace_events.DYNAMIC_NAMES
+    check_trace_events.DYNAMIC_NAMES = {}
+    try:
+        rc = check_trace_events.main([str(pkg), str(doc)])
+        assert rc == 1  # `prefill` missing
+        doc.write_text("`prefill` and `late_span`\n")
+        assert check_trace_events.main([str(pkg), str(doc)]) == 0
+    finally:
+        check_trace_events.DYNAMIC_NAMES = old
+
+
+def test_stale_dynamic_pin_fails(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("x = 1\n")
+    doc = tmp_path / "doc.md"
+    doc.write_text("nothing emitted, `unity_iter` documented anyway\n")
+    rc = check_trace_events.main([str(pkg), str(doc)])
+    assert rc == 1
+    assert "dynamic pin" in capsys.readouterr().err
+
+
+def test_host_overhead_fraction_in_telemetry_blocks():
+    from flexflow_tpu.obs.telemetry import StepTelemetry
+
+    tel = StepTelemetry(batch_size=1, phase="serving")
+    tel.requests_served = 2
+    tel.tokens_generated = 8
+    tel.finalize()
+    assert "host_overhead_fraction" not in tel.summary()["serving"]
+    tel.serving_host_overhead_fraction = 0.125
+    assert tel.summary()["serving"]["host_overhead_fraction"] == 0.125
+    tel2 = StepTelemetry(batch_size=1, phase="fleet")
+    tel2.fleet_replicas = 2
+    tel2.finalize()
+    assert "host_overhead_fraction" not in tel2.summary()["fleet"]
+    tel2.fleet_host_overhead_fraction = 0.25
+    assert tel2.summary()["fleet"]["host_overhead_fraction"] == 0.25
+
+
+def test_host_overhead_fraction_math():
+    """fraction = (dispatch + bookkeep) / total; None before any tick."""
+    from flexflow_tpu.serving.engine import ServingStats
+    from flexflow_tpu.serving.fleet import FleetStats
+
+    st = ServingStats()
+    assert st.host_overhead_fraction() is None
+    st.host_dispatch_s = 1.0
+    st.host_device_s = 6.0
+    st.host_bookkeep_s = 1.0
+    assert st.host_overhead_fraction() == 0.25
+    fs = FleetStats(replicas=1, dispatches=[0])
+    assert fs.host_overhead_fraction() is None
+    fs.host_dispatch_s = 3.0
+    fs.host_device_s = 9.0
+    assert fs.host_overhead_fraction() == 0.25

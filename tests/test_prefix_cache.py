@@ -572,3 +572,87 @@ def test_pool_rebuild_and_reset_drop_trie(gpt2):
     eng._last_tokens = None
     assert eng.generate(PROMPTS, max_new_tokens=6) == cold
     assert eng.block_allocator.in_use == eng._prefix.n_blocks
+
+
+# ------------------------------------------- TTFT stamp @ commit (ISSUE 16)
+def test_first_token_ms_stamps_at_commit_point():
+    """ISSUE 16 satellite pin: the TTFT stamp lands at the COMMIT point
+    (``commit_token``), not inside the prefill work — so any admission
+    path that skips prefill compute (a full prefix hit, a hedge twin
+    resuming copied tokens) still stamps the first token it commits.
+    Scheduler-level, fake clock: first commit stamps, later commits
+    don't move it, and the max_new_tokens=1 edge (commit and finish in
+    the same call) carries both stamps."""
+    t = [0.0]
+    sched = ContinuousBatchScheduler(n_slots=1, max_queue=4, max_len=32,
+                                     clock=lambda: t[0])
+    r = Request(prompt=np.zeros(3, np.int32), max_new_tokens=2)
+    sched.submit(r)
+    sched.next_action()  # admitted; prefill does NOT stamp
+    assert r.first_token_ms == 0.0
+    t[0] = 3.0
+    sched.commit_token(0, 7)
+    assert r.first_token_ms == 3.0, "stamp must land at the commit"
+    t[0] = 8.0
+    sched.commit_token(0, 8)  # finishes (length 2)
+    assert r.first_token_ms == 3.0, "first stamp wins"
+    assert r.finish_ms == 8.0
+    # the one-token edge: the first commit IS the terminal commit
+    r1 = Request(prompt=np.zeros(3, np.int32), max_new_tokens=1)
+    sched.submit(r1)
+    sched.next_action()
+    t[0] = 12.0
+    sched.commit_token(0, 9)
+    assert r1.first_token_ms == 12.0 and r1.finish_ms == 12.0
+
+
+def test_full_prefix_hit_first_token_stamped(gpt2):
+    """A request admitted behind a FULL prefix hit (the trie holds its
+    entire prompt; admission caps the mapped hit at effective_len - 1,
+    so prefill computes exactly one suffix token) must report a real
+    ``first_token_ms`` — including at max_new_tokens=1, where the
+    prefill tick commits the only token the request will ever emit."""
+    ff, _cfg = gpt2
+    eng = _engine(ff)
+    warm = SYS_PROMPT + [5, 6, 7]
+    eng.generate([warm], max_new_tokens=4)  # trie now spans the prompt
+    for max_new in (1, 4):
+        sched = ContinuousBatchScheduler(n_slots=2, max_queue=4,
+                                         max_len=eng.max_decode_len)
+        eng._attach_kv_accounting(sched)
+        r = Request(prompt=np.asarray(warm, np.int32),
+                    max_new_tokens=max_new, rng_tag=0)
+        sched.submit(r)
+        eng.serve(sched)
+        assert r.prefix_hit_tokens >= len(warm) - 1, \
+            "test setup: expected a (capped) full-prompt trie hit"
+        assert r.outcome in (None, "ok") and len(r.generated) == max_new
+        assert r.first_token_ms > 0, \
+            f"TTFT stamp missing on full-hit path (max_new={max_new})"
+        assert r.finish_ms >= r.first_token_ms
+
+
+def test_chunk_overhang_past_context_stays_finite_and_bitwise(gpt2):
+    """Regression: a trie-hit suffix chunk admitted deep into the
+    prompt can OVERHANG the position table (start + chunk_shape >
+    seq_len — here a 40-token hit leaves a 1-token suffix under a
+    32-wide chunk program, rows 40..71 against a 64-entry table).
+    jnp.take's fill mode turned the pad rows' position gather into NaN
+    embeddings; their k/v rows landed in the garbage block and the
+    gathered extent's softmax-zero x NaN poisoned the REAL row — the
+    warm rerun decoded all-zero tokens and the pool stayed NaN for
+    every later request. Pad positions now clamp to the chunk's last
+    real row: warm rerun bitwise, pool finite."""
+    import jax
+
+    ff, cfg = gpt2
+    eng = _engine(ff, prefill_chunk_tokens=32)
+    prompt = list(range(1, 42))  # block-aligned 40-token hit, suffix 1
+    r1 = eng.generate([prompt], max_new_tokens=8)
+    r2 = eng.generate([prompt], max_new_tokens=8)
+    assert eng.stats.prefix_hits >= 1
+    assert r2 == r1, "overhanging suffix chunk perturbed the warm stream"
+    for entry in eng.state.caches.values():
+        for leaf in entry:
+            assert np.isfinite(np.asarray(jax.device_get(leaf))).all(), \
+                "non-finite rows leaked into the KV pool"

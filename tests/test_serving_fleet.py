@@ -228,8 +228,57 @@ def test_hedge_twin_wins_no_double_count_bitwise(gpt2):
         assert sum(st.outcomes.values()) == 4
         assert st.outcomes == {"ok": 4}
         assert outs == base, "hedged streams diverged"
+        # ISSUE 16 satellite pin: adoption mirrors the LATENCY STAMPS
+        # with the tokens — every caller-held request reports a real
+        # TTFT/completion time even when its winning copy was the twin
+        for r in fleet._requests:
+            assert r.first_token_ms > 0, "TTFT stamp lost in adoption"
+            assert r.finish_ms >= r.first_token_ms > 0
     finally:
         config.hedge_after_pctl = 0.0
+
+
+def test_hedge_adoption_mirrors_latency_stamps(gpt2):
+    """ISSUE 16 satellite fix pin: when a hedge TWIN wins, its
+    ``first_token_ms`` / ``finish_ms`` must be mirrored onto the
+    caller-held primary along with the tokens — before the fix the
+    primary kept stamps of 0.0, so bench TTFT went negative and the
+    request trace reported a zero-latency completion."""
+    from flexflow_tpu.serving.fleet import _Hedge
+
+    ff, cfg = gpt2
+    fleet = _fleet(ff, cfg)
+    p = Request(prompt=np.zeros(3, np.int32), max_new_tokens=4, rng_tag=0)
+    t = Request(prompt=np.zeros(3, np.int32), max_new_tokens=4, rng_tag=0,
+                generated=[1, 2, 3, 4])
+    t.done = True
+    t.outcome = "ok"
+    t.finish_reason = "length"
+    t.first_token_ms = 123.0
+    t.finish_ms = 456.0
+    fleet._adopted.append(_Hedge(primary=p, twin=t, fork=0,
+                                 primary_replica=0, twin_replica=1))
+    fleet._mirror_adopted()
+    assert p.generated == [1, 2, 3, 4]
+    assert p.first_token_ms == 123.0, "twin's TTFT stamp not mirrored"
+    assert p.finish_ms == 456.0, "twin's finish stamp not mirrored"
+    # a primary that committed tokens BEFORE the hedge fork keeps its
+    # own, earlier TTFT — first token is first token wherever it landed
+    p2 = Request(prompt=np.zeros(3, np.int32), max_new_tokens=4,
+                 rng_tag=1, generated=[9])
+    p2.first_token_ms = 50.0
+    t2 = Request(prompt=np.zeros(3, np.int32), max_new_tokens=4,
+                 rng_tag=1, generated=[9, 10])
+    t2.done = True
+    t2.outcome = "ok"
+    t2.finish_reason = "length"
+    t2.first_token_ms = 50.0
+    t2.finish_ms = 99.0
+    fleet._adopted.append(_Hedge(primary=p2, twin=t2, fork=1,
+                                 primary_replica=0, twin_replica=1))
+    fleet._mirror_adopted()
+    assert p2.first_token_ms == 50.0
+    assert p2.finish_ms == 99.0
 
 
 def test_hedge_cap_and_idle_target_only(gpt2):
