@@ -286,6 +286,12 @@ class FFConfig:
     # decode-health sentinel: retries per request after a quarantined
     # (non-finite) decode slot before the request aborts as decode_fault
     decode_retry_budget: int = 1
+    # serve-loop runtime (ISSUE 17, docs/serving.md "Async runtime"):
+    # "sync" (reference: block on step k's tokens before dispatching
+    # k+1) or "async" (double-buffered: dispatch k+1 while k's transfer
+    # is in flight, commit at arrival — bitwise the sync streams under
+    # exact decode, at a lower host_overhead_fraction)
+    serve_loop: str = "sync"
     # serving fleet (flexflow_tpu/serving/fleet.py, docs/fleet.md;
     # ISSUE 11). Replica count of the multi-replica router: N independent
     # fault domains behind load-aware dispatch with health-checked
@@ -540,6 +546,12 @@ class FFConfig:
                 self.drain_grace_s = float(_next())
             elif a == "--decode-retry-budget":
                 self.decode_retry_budget = int(_next())
+            elif a == "--serve-loop":
+                v = _next()
+                if v not in ("sync", "async"):
+                    raise ValueError(
+                        f"--serve-loop expects sync|async, got {v!r}")
+                self.serve_loop = v
             elif a == "--fleet-replicas":
                 self.fleet_replicas = int(_next())
             elif a == "--hedge-after-pctl":

@@ -132,6 +132,12 @@ def preflight_config(config) -> None:
     if hs not in ("auto", "on", "off"):
         raise PreflightError(
             f"--hierarchical-search expects auto|on|off, got {hs!r}")
+    sl = (getattr(config, "serve_loop", "sync") or "sync")
+    if sl not in ("sync", "async"):
+        raise PreflightError(
+            f"--serve-loop expects sync|async, got {sl!r}: sync is the "
+            "blocking reference loop, async the double-buffered runtime "
+            "(bitwise-identical streams under exact decode)")
 
 
 # --------------------------------------------------------------- strategy

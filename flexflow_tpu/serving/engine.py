@@ -121,6 +121,15 @@ class ServingStats:
     host_device_s: float = 0.0
     host_bookkeep_s: float = 0.0
     host_ticks: int = 0
+    # async double-buffered runtime (ISSUE 17): host work performed
+    # WHILE a device step was already in flight — off the critical path,
+    # so it joins the denominator but never the numerator of
+    # host_overhead_fraction (the sync loop leaves it 0, preserving the
+    # PR 16 accounting identity). host_syncs counts BLOCKING host
+    # transfers through the one decode fetch choke point — the async
+    # steady-state contract is <= 1 per committed decode step
+    host_overlap_s: float = 0.0
+    host_syncs: int = 0
 
     def record_token(self, wall_s: float) -> None:
         self.token_walls_s.append(wall_s)
@@ -163,9 +172,13 @@ class ServingStats:
     def host_overhead_fraction(self) -> Optional[float]:
         """Fraction of the serve loop's tick wall spent on the host
         (dispatch + bookkeeping) rather than waiting on the device —
-        ROADMAP item 5's headline number. None before any tick ran."""
+        ROADMAP item 5's headline number. None before any tick ran.
+        Overlapped host work (ISSUE 17: bookkeeping performed while the
+        next step was already in flight) extends the wall the loop
+        covered without costing the device anything, so it counts in
+        the denominator only."""
         total = self.host_dispatch_s + self.host_device_s + \
-            self.host_bookkeep_s
+            self.host_bookkeep_s + self.host_overlap_s
         if total <= 0.0:
             return None
         return (self.host_dispatch_s + self.host_bookkeep_s) / total
@@ -220,6 +233,8 @@ class ServingStats:
         hof = self.host_overhead_fraction()
         if hof is not None:
             out["host_overhead_fraction"] = round(hof, 4)
+        if self.host_syncs:
+            out["host_syncs"] = self.host_syncs
         return out
 
 
@@ -247,7 +262,8 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  prefix_cache: Optional[str] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_cache_blocks: Optional[int] = None):
+                 prefix_cache_blocks: Optional[int] = None,
+                 serve_loop: Optional[str] = None):
         assert model.executor is not None, "call model.compile() first"
         self.model = model
         self.executor = model.executor
@@ -266,6 +282,19 @@ class ServingEngine:
         # paged KV cache (ISSUE 12, docs/serving.md "Paged KV cache"):
         # "paged" (default) = block pool + per-slot tables, "ring" = the
         # legacy per-slot max_len buffers (the bitwise reference layout)
+        # serve-loop runtime (ISSUE 17, docs/serving.md "Async
+        # runtime"): "sync" (default) blocks on each decode step's host
+        # transfer before dispatching the next; "async" double-buffers —
+        # step k+1 is enqueued on-device while step k's (tokens, ok)
+        # transfer is in flight, commits land at transfer ARRIVAL. Both
+        # run the same device programs; async must match sync
+        # stream-for-stream bitwise under exact decode (tier-1 pins it)
+        self.serve_loop = str(serve_loop or
+                              getattr(cfg, "serve_loop", "sync") or "sync")
+        if self.serve_loop not in ("sync", "async"):
+            raise ValueError(
+                f"serve_loop must be 'sync' or 'async', got "
+                f"{self.serve_loop!r}")
         self.kv_cache = str(kv_cache or getattr(cfg, "kv_cache", "paged"))
         self.kv_block_size = int(kv_block_size or
                                  getattr(cfg, "kv_block_size", 16))
@@ -928,11 +957,17 @@ class ServingEngine:
         hook the fleet router (``serving/fleet.py``, ISSUE 11) uses to
         interleave N replicas' progress in one host loop; standalone
         ``serve()`` is exactly ``start_serve`` + ``while tick()`` +
-        ``finish()``."""
-        return _ServeLoop(self, sched, temperature=temperature,
-                          top_k=top_k, seed=seed, chaos=chaos,
-                          resilience=resilience,
-                          publish_telemetry=publish_telemetry)
+        ``finish()``.
+
+        ISSUE 17: ``--serve-loop async`` returns the double-buffered
+        :class:`_AsyncServeLoop` instead — same contract, but one decode
+        step's result may be IN FLIGHT between ticks (``settle()``
+        forces arrival; ``finish()`` always settles first)."""
+        cls = _AsyncServeLoop if self.serve_loop == "async" else _ServeLoop
+        return cls(self, sched, temperature=temperature,
+                   top_k=top_k, seed=seed, chaos=chaos,
+                   resilience=resilience,
+                   publish_telemetry=publish_telemetry)
 
     def serve(self, sched: ContinuousBatchScheduler,
               temperature: float = 0.0, top_k: int = 0,
@@ -1347,6 +1382,39 @@ class _ServeLoop:
                               queued=sched.queued, active=sched.active,
                               grace_s=res.drain_grace_s)
 
+    # -------------------------------------------------- pending transfers
+    def settle(self) -> None:
+        """Force any in-flight decode result to arrive and commit — the
+        async runtime's explicit drain point (ISSUE 17). Every path
+        that must observe settled scheduler/ledger state calls it:
+        ``finish()``, the drain-grace eviction, the fleet's
+        harvest/kill/migration, and the DecodeStateLost rebuild. The
+        sync loop never has a pending transfer, so this is a no-op."""
+        self._settle_pending()
+
+    def _settle_pending(self) -> None:
+        return None
+
+    def _fetch(self, toks, ok_vec):
+        """The ONE blocking host-transfer choke point for decode results
+        (ISSUE 17 satellite: the formerly separate guarded/unguarded
+        ``device_get`` call sites unified). Both the sync loop and the
+        async runtime's pending-transfer settle route through here, so
+        counting blocking host syncs means counting THIS
+        (``stats.host_syncs``; the async steady-state contract is <= 1
+        per committed decode step). Returns ``(tokens (n_slots,)
+        np.int32, ok (n_slots,) bool-or-None)``."""
+        import jax
+
+        self.stats.host_syncs += 1
+        if ok_vec is not None:
+            # the ONE extra transfer of the guarded step: the per-slot
+            # finite verdict rides the same device_get as the tokens —
+            # still a single blocking sync
+            toks_host, ok_host = jax.device_get((toks, ok_vec))
+            return np.asarray(toks_host), np.asarray(ok_host)
+        return np.asarray(jax.device_get(toks)), None
+
     # ----------------------------------------------------------------- tick
     def _acct_tick(self, t_tick: float, t_dev: float,
                    dev_s: float) -> None:
@@ -1370,14 +1438,15 @@ class _ServeLoop:
         import jax
         import jax.numpy as jnp
 
-        from .resilience import DecodeStateLostError
-
         eng, sched, res = self.engine, self.sched, self.res
-        stats, tracer, chaos = self.stats, self.tracer, self.chaos
+        stats, tracer = self.stats, self.tracer
         if self.draining and sched.active and \
                 res.clock() > self.drain_deadline_ms:
             # grace exhausted: stragglers are evicted (outcome
-            # preempted), never silently dropped
+            # preempted), never silently dropped. In-flight tokens land
+            # first (async): a token the device already produced inside
+            # the grace window belongs to the stream
+            self._settle_pending()
             for slot, r in enumerate(list(sched.slots)):
                 if r is not None:
                     sched.evict(slot, "preempted")
@@ -1386,7 +1455,7 @@ class _ServeLoop:
             eng._sweep_deadlines(sched, res, tracer)
         action = sched.next_action()
         if action is None:
-            return False
+            return self._idle()
         if action[0] == "prefill":
             _, req, slot, bucket = action
             if self.res_active and req.expired(res.clock()):
@@ -1521,100 +1590,139 @@ class _ServeLoop:
                 eng._set_slot_meta(slot, eff, tok, row)
             self._acct_tick(t_tick, t_p, wall)
             return True
-        # decode: one token for every live slot. Sampling covers ALL
-        # slots (free ones with a dummy rng, their draws discarded) so
-        # the sampler's shapes are as static as the decode step's — the
-        # whole loop compiles a bounded, occupancy-independent set of
-        # programs.
-        _, live = action
-        k = stats.decode_steps  # the chaos-script step index
-        if chaos is not None:
-            chaos.maybe_preempt_serving(k)
-            for p in chaos.maybe_storm(k):
-                r = Request(prompt=np.asarray(p, np.int32),
-                            max_new_tokens=chaos.storm_max_new_tokens,
-                            eos_id=eng.eos_id,
-                            rng_tag=1_000_000 + self.storm_seq)
-                self.storm_seq += 1
-                try:
-                    res.admit(sched, r)
-                except ServingRejection:
-                    pass  # counted by the controller; outcome shed
-            if eng.state is not None:
-                eng.state, poisoned = chaos.maybe_poison_decode(
-                    k, eng.state)
-                if poisoned is not None and tracer.enabled:
-                    tracer.event("decode_poison", step=k, slot=poisoned)
-        t_d = time.perf_counter()
-        try:
-            logits, ok_vec = eng._dispatch_decode(
-                self.params, res, chaos, k, self.guard, tracer)
-        except DecodeStateLostError:
-            # the slot pool died with the device. Committed tokens are
-            # host-side on each Request, so recovery is the
-            # quarantine-retry path applied to EVERY live stream: back
-            # to the queue front, re-prefilled onto the rebuilt pool
-            # (rng streams key on (tag, tokens_emitted) — continuations
-            # are unchanged). A stream whose committed length outgrew
-            # the prefill buckets cannot re-enter and is evicted
-            # (preempted). Drop the dead state FIRST: the quarantine
-            # path's on_slot_freed hook must see an empty pool, not
-            # deleted buffers
-            eng.state = None
-            eng._last_tokens = None
-            if eng._prefix is not None:
-                # the cached blocks died with the pool: drop the trie
-                # BEFORE the quarantined requests re-enter admission,
-                # or their re-prefills would map stale block ids into
-                # the zeroed rebuild
-                eng._prefix.clear(free=True)
-            # EVERY occupied slot re-enters — mid-chunk prefills
-            # included (their partially-written pool rows died with the
-            # pool; re-admission restarts the prefill, re-walking the
-            # trie, which _ensure_state cleared alongside the pool)
-            requeued = 0
-            for slot, req in enumerate(list(sched.slots)):
-                if req is None:
-                    continue
-                requeued += 1
-                try:
-                    bucket_for(req.effective_len, sched.buckets)
-                except ValueError:
-                    sched.evict(slot, "preempted")
-                    continue
-                sched.quarantine(slot)
-            if tracer.enabled:
-                tracer.event("serving_state_rebuild", step=k,
-                             requeued=requeued)
-            self._acct_tick(t_tick, t_d, 0.0)
-            return True
-        live_map = dict(live)
-        # per-slot rng streams depend on (submission tag, tokens
-        # emitted), never on slot index or batch composition — built as
-        # ONE host numpy array, folded in-jit
+        # decode: one token for every live slot — through the sync
+        # (reference) or async (double-buffered) _tick_decode variant
+        return self._tick_decode(t_tick, action[1])
+
+    def _idle(self) -> bool:
+        """No scheduler action is available right now. The async loop
+        may still hold an in-flight result whose arrival IS the
+        remaining work (an EOS frees a slot, a quarantine requeues);
+        the sync loop is simply done."""
+        return False
+
+    # ---------------------------------------------------- decode building
+    # blocks shared by the sync reference and the async runtime — ONE
+    # implementation of chaos injection, device-loss rebuild, sampling
+    # and the commit point, so the two loops can only diverge in WHEN
+    # the commit happens, never in WHAT it does
+    def _chaos_hooks(self, k: int) -> None:
+        """Scripted chaos at the decode-step boundary ``k``. The async
+        runtime keys ``k`` on its DISPATCH counter: at injection time
+        the sync loop's ``stats.decode_steps`` equals its dispatch
+        count, so the same script fires at the same logical step in
+        both loops."""
+        eng, sched, res = self.engine, self.sched, self.res
+        chaos, tracer = self.chaos, self.tracer
+        if chaos is None:
+            return
+        chaos.maybe_preempt_serving(k)
+        for p in chaos.maybe_storm(k):
+            r = Request(prompt=np.asarray(p, np.int32),
+                        max_new_tokens=chaos.storm_max_new_tokens,
+                        eos_id=eng.eos_id,
+                        rng_tag=1_000_000 + self.storm_seq)
+            self.storm_seq += 1
+            try:
+                res.admit(sched, r)
+            except ServingRejection:
+                pass  # counted by the controller; outcome shed
+        if eng.state is not None:
+            eng.state, poisoned = chaos.maybe_poison_decode(
+                k, eng.state)
+            if poisoned is not None and tracer.enabled:
+                tracer.event("decode_poison", step=k, slot=poisoned)
+
+    def _rebuild_lost_state(self, k: int) -> None:
+        """The slot pool died with the device. Committed tokens are
+        host-side on each Request, so recovery is the quarantine-retry
+        path applied to EVERY live stream: back to the queue front,
+        re-prefilled onto the rebuilt pool (rng streams key on (tag,
+        tokens_emitted) — continuations are unchanged). A stream whose
+        committed length outgrew the prefill buckets cannot re-enter
+        and is evicted (preempted). Drop the dead state FIRST: the
+        quarantine path's on_slot_freed hook must see an empty pool,
+        not deleted buffers."""
+        eng, sched, tracer = self.engine, self.sched, self.tracer
+        eng.state = None
+        eng._last_tokens = None
+        if eng._prefix is not None:
+            # the cached blocks died with the pool: drop the trie
+            # BEFORE the quarantined requests re-enter admission, or
+            # their re-prefills would map stale block ids into the
+            # zeroed rebuild
+            eng._prefix.clear(free=True)
+        # EVERY occupied slot re-enters — mid-chunk prefills included
+        # (their partially-written pool rows died with the pool;
+        # re-admission restarts the prefill, re-walking the trie,
+        # which _ensure_state cleared alongside the pool)
+        requeued = 0
+        for slot, req in enumerate(list(sched.slots)):
+            if req is None:
+                continue
+            requeued += 1
+            try:
+                bucket_for(req.effective_len, sched.buckets)
+            except ValueError:
+                sched.evict(slot, "preempted")
+                continue
+            sched.quarantine(slot)
+        if tracer.enabled:
+            tracer.event("serving_state_rebuild", step=k,
+                         requeued=requeued)
+
+    def _sample(self, live, logits, pending=None):
+        """Sample every slot's next token on device and feed the result
+        back as the next step's input (``_last_tokens`` — set from the
+        DEVICE array, never a host copy, which is what lets the async
+        runtime dispatch k+1 before k's transfer lands). Per-slot rng
+        streams depend on (submission tag, tokens emitted), never on
+        slot index or batch composition — built as ONE host numpy
+        array, folded in-jit. ``pending``: the async runtime's
+        in-flight step — a slot whose previous token is still
+        uncommitted samples at count+1, the count it will have when
+        that token lands (a pending token that ends up discarded —
+        EOS, quarantine — discards this draw too, so the +1 can never
+        desync a stream)."""
+        eng, sched = self.engine, self.sched
         tag_counts = np.zeros((eng.n_slots, 2), np.int32)
-        for s, r in live_map.items():
+        for s, r in live:
             tag_counts[s, 0] = r.rng_tag if r.rng_tag is not None \
                 else r.rid
             tag_counts[s, 1] = len(r.generated)
+        if pending is not None:
+            for (s, r), e in zip(pending.live, pending.epochs):
+                if sched.slots[s] is r and sched.slot_epoch[s] == e:
+                    tag_counts[s, 1] += 1
         toks = self.sampler(logits, self.base_rng, tag_counts)
         eng._last_tokens = toks[:, None]
-        if ok_vec is not None:
-            # the ONE extra transfer of the guarded step: the per-slot
-            # finite verdict rides the same device_get
-            toks_host, ok_host = jax.device_get((toks, ok_vec))
-            toks_host = np.asarray(toks_host)
-            ok_host = np.asarray(ok_host)
-        else:
-            toks_host = np.asarray(jax.device_get(toks))
-            ok_host = None
-        wall = time.perf_counter() - t_d
+        return toks
+
+    def _commit_arrival(self, live, epochs, toks_host, ok_host,
+                        wall: float) -> None:
+        """THE commit point: one settled decode step's bookkeeping —
+        token commits (EOS/length recycling inside ``commit_token``),
+        quarantine verdicts, latency/ledger stats, reqtrace stamps. The
+        sync loop runs it immediately after its blocking fetch; the
+        async runtime runs it at transfer ARRIVAL, one step behind
+        dispatch, with ``epochs`` guarding against slots recycled while
+        the result was in flight."""
+        eng, sched, res = self.engine, self.sched, self.res
+        stats, tracer = self.stats, self.tracer
         stats.decode_steps += 1
         self.step_no += 1
         stats.kv_bytes_read += eng._decode_kv_bytes(live)
         if self.res_active:
             res.controller.observe_step(wall, len(live))
-        for slot, req in live:
+        for i, (slot, req) in enumerate(live):
+            if epochs is not None and (
+                    sched.slots[slot] is not req
+                    or sched.slot_epoch[slot] != epochs[i]):
+                # the slot was recycled while this result was in flight
+                # (EOS/length/deadline/quarantine at the previous
+                # settle): the one-deep pipeline's extra draw is
+                # discarded — exactly one terminal outcome per request
+                continue
             if ok_host is not None and not bool(ok_host[slot]):
                 # poisoned slot: quarantine it alone — the token is NOT
                 # committed, neighbors proceed untouched
@@ -1626,6 +1734,28 @@ class _ServeLoop:
         if tracer.enabled:
             tracer.complete("decode_step", wall, step=self.step_no,
                             live_slots=len(live))
+
+    def _tick_decode(self, t_tick: float, live) -> bool:
+        """One decode step, fully synchronous — the reference
+        implementation the async runtime must match stream-for-stream:
+        dispatch, BLOCK on the host transfer, commit."""
+        from .resilience import DecodeStateLostError
+
+        eng, res = self.engine, self.res
+        k = self.stats.decode_steps  # the chaos-script step index
+        self._chaos_hooks(k)
+        t_d = time.perf_counter()
+        try:
+            logits, ok_vec = eng._dispatch_decode(
+                self.params, res, self.chaos, k, self.guard, self.tracer)
+        except DecodeStateLostError:
+            self._rebuild_lost_state(k)
+            self._acct_tick(t_tick, t_d, 0.0)
+            return True
+        toks = self._sample(live, logits)
+        toks_host, ok_host = self._fetch(toks, ok_vec)
+        wall = time.perf_counter() - t_d
+        self._commit_arrival(live, None, toks_host, ok_host, wall)
         self._acct_tick(t_tick, t_d, wall)
         return True
 
@@ -1677,3 +1807,164 @@ class _ServeLoop:
             if tracer.enabled and eng.model.config.trace_file:
                 tracer.write(eng.model.config.trace_file)
         return stats
+
+
+@dataclasses.dataclass
+class _PendingStep:
+    """One in-flight decode step of the async runtime (ISSUE 17): the
+    device arrays whose host transfer is pending, plus everything the
+    commit needs when the result lands. ``epochs`` snapshots the slot
+    incarnation counters at DISPATCH time — a slot recycled while the
+    result was in flight discards its entry at settle (the one-deep
+    pipeline's extra draw), identity checked per (slot, request,
+    epoch)."""
+
+    toks: Any
+    ok_vec: Any
+    live: List
+    epochs: List[int]
+    t_d: float
+
+
+class _AsyncServeLoop(_ServeLoop):
+    """The double-buffered serve loop behind ``--serve-loop async``
+    (ISSUE 17, docs/serving.md "Async runtime"): decode step k+1 is
+    dispatched on-device while step k's ``(tokens, ok_vec)`` transfer
+    is still in flight, and ALL commit-point bookkeeping — token
+    commits, EOS/length recycling, quarantine verdicts, reqtrace
+    stamps — fires at transfer ARRIVAL, one step behind dispatch,
+    overlapped with step k+1's device execution. The host Python loop
+    leaves the decode critical path: the only blocking host sync per
+    committed step is the settle's fetch (``stats.host_syncs`` pins
+    it).
+
+    What makes the one-deep pipeline safe:
+
+    * the decode feedback token is read from the DEVICE array
+      (``_last_tokens = toks[:, None]`` in ``_sample``) — dispatch k+1
+      never needs k's host copy;
+    * per-slot rng streams key on (tag, tokens_emitted), with pending
+      in-flight tokens counted (+1), so sampled streams are bitwise
+      the sync loop's regardless of commit lag;
+    * the extra in-flight step a finishing/quarantined slot runs
+      writes only at positions >= the adopted prefix extent of blocks
+      released at settle, and every released block is fully
+      re-prefilled (data-dependency ordered through the donated state)
+      before any read — the standing overwrite-before-read invariant;
+    * slot-epoch guards discard in-flight results for recycled slots
+      (``ContinuousBatchScheduler.slot_epoch``).
+
+    Drain points — everything that must observe settled state calls
+    ``settle()`` first: ``finish()``, the drain-grace eviction, the
+    idle transition, the DecodeStateLost rebuild, and the fleet's
+    harvest/kill/migration paths (serving/fleet.py)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pending: Optional[_PendingStep] = None
+        # chaos scripts key on DISPATCH order: at injection time the
+        # sync loop's stats.decode_steps equals its dispatch count, so
+        # a dispatch counter reproduces the exact injection points
+        # (stats.decode_steps lags one settle behind here)
+        self.dispatch_no = 0
+
+    # ---------------------------------------------------------- settling
+    def _settle_step(self, p: _PendingStep) -> float:
+        """Block until ``p``'s transfer lands, then run the commit
+        point. Returns the seconds actually spent BLOCKED (the only
+        part of the settle that is device wait, not host work)."""
+        t_s = time.perf_counter()
+        toks_host, ok_host = self._fetch(p.toks, p.ok_vec)
+        blocked = time.perf_counter() - t_s
+        self.stats.host_device_s += blocked
+        wall = time.perf_counter() - p.t_d
+        self._commit_arrival(p.live, p.epochs, toks_host, ok_host, wall)
+        return blocked
+
+    def _settle_pending(self) -> None:
+        """The explicit drain point (``settle()``): force the in-flight
+        step to arrive and commit. Outside the decode hot path nothing
+        overlaps the commit work, so it lands in the bookkeep bucket."""
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        t0 = time.perf_counter()
+        blocked = self._settle_step(p)
+        self.stats.host_bookkeep_s += max(
+            time.perf_counter() - t0 - blocked, 0.0)
+
+    def _idle(self) -> bool:
+        if self._pending is None:
+            return False
+        # the in-flight step IS the remaining work: its arrival commits
+        # tokens, frees slots, possibly requeues a quarantined stream —
+        # the next tick sees a live scheduler again
+        self._settle_pending()
+        return True
+
+    # ------------------------------------------------------------- decode
+    def _tick_decode(self, t_tick: float, live) -> bool:
+        """One double-buffered decode step: dispatch k+1 FIRST (device
+        starts immediately), then settle k's pending transfer and do
+        its commit bookkeeping while k+1 executes. Steady state: one
+        blocking host sync (the settle fetch) per committed step."""
+        from .resilience import DecodeStateLostError
+
+        eng, res, stats = self.engine, self.res, self.stats
+        # with a step already in flight the device stays busy through
+        # this tick's prework — host work only hits the critical path
+        # when the pipeline is empty (first step of a burst)
+        pipelined = self._pending is not None
+        k = self.dispatch_no  # chaos keys on dispatch order
+        self._chaos_hooks(k)
+        t_d = time.perf_counter()
+        try:
+            logits, ok_vec = eng._dispatch_decode(
+                self.params, res, self.chaos, k, self.guard, self.tracer)
+        except DecodeStateLostError:
+            # settle FIRST: at this logical point the sync loop had
+            # already committed step k-1's tokens — the rebuild's
+            # re-prefills must resume from the same committed streams.
+            # A scripted loss leaves the pending buffers alive; a real
+            # loss that killed them too loses that step's tokens (the
+            # requests re-prefill one token earlier — still a valid
+            # stream position)
+            try:
+                self._settle_pending()
+            except Exception:
+                self._pending = None  # buffers died with the device
+            self._rebuild_lost_state(k)
+            stats.host_dispatch_s += max(t_d - t_tick, 0.0)
+            stats.host_ticks += 1
+            return True
+        issued = time.perf_counter()
+        if pipelined:
+            stats.host_overlap_s += max(issued - t_tick, 0.0)
+        else:
+            stats.host_dispatch_s += max(issued - t_tick, 0.0)
+        # the device is busy with step k from here on: the sampler
+        # dispatch, the early transfer start and the PREVIOUS step's
+        # entire commit bookkeeping all overlap its execution — that is
+        # the double buffer. Only the settle's blocking fetch counts as
+        # device wait
+        toks = self._sample(live, logits, pending=self._pending)
+        ok_arr = (ok_vec,) if ok_vec is not None else ()
+        for arr in (toks,) + ok_arr:
+            try:
+                arr.copy_to_host_async()  # start D2H behind the compute
+            except (AttributeError, TypeError):
+                pass  # backend without async host copies: settle blocks
+        prev, self._pending = self._pending, _PendingStep(
+            toks=toks, ok_vec=ok_vec, live=list(live),
+            epochs=[self.sched.slot_epoch[s] for s, _ in live], t_d=t_d)
+        self.dispatch_no += 1
+        blocked = self._settle_step(prev) if prev is not None else 0.0
+        stats.host_overlap_s += max(
+            time.perf_counter() - issued - blocked, 0.0)
+        stats.host_ticks += 1
+        return True
+
+    # ------------------------------------------------------------- finish
+    def finish(self) -> ServingStats:
+        self._settle_pending()
+        return super().finish()

@@ -173,9 +173,10 @@ class FleetReplica:
         self.retired_tokens = 0
         self.retired_decode_steps = 0
         # host-overhead seconds of retired loops: [dispatch, device,
-        # bookkeep] (ISSUE 16) — the fleet roll-up must not lose the
-        # wall split of a loop a drain/rejoin rebuilt
-        self.retired_host = [0.0, 0.0, 0.0]
+        # bookkeep, overlap] (ISSUE 16/17) — the fleet roll-up must not
+        # lose the wall split of a loop a drain/rejoin rebuilt
+        self.retired_host = [0.0, 0.0, 0.0, 0.0]
+        self.retired_syncs = 0
 
     @property
     def alive(self) -> bool:
@@ -268,6 +269,13 @@ class FleetStats:
     host_dispatch_s: float = 0.0
     host_device_s: float = 0.0
     host_bookkeep_s: float = 0.0
+    # host work overlapped with in-flight device steps (the async serve
+    # loop, ISSUE 17): wall that exists but is NOT overhead — it widens
+    # the denominator only
+    host_overlap_s: float = 0.0
+    # blocking host transfers across all replica loops (ISSUE 17): the
+    # fleet analog of ServingStats.host_syncs
+    host_syncs: int = 0
 
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         if n:
@@ -304,7 +312,7 @@ class FleetStats:
         """Fleet-wide fraction of serve wall spent on the host rather
         than waiting on devices (ServingStats analog; ISSUE 16)."""
         total = self.host_dispatch_s + self.host_device_s + \
-            self.host_bookkeep_s
+            self.host_bookkeep_s + self.host_overlap_s
         if total <= 0.0:
             return None
         return (self.host_dispatch_s + self.host_bookkeep_s) / total
@@ -322,6 +330,8 @@ class FleetStats:
         hof = self.host_overhead_fraction()
         if hof is not None:
             out["host_overhead_fraction"] = round(hof, 4)
+        if self.host_syncs:
+            out["host_syncs"] = self.host_syncs
         if self.outcomes:
             out["outcomes"] = dict(self.outcomes)
         for k in ("sheds", "migrations", "requeued", "failovers", "hedges",
@@ -416,7 +426,7 @@ class ServingFleet:
                  exact_decode: bool = False,
                  plans: Optional[Sequence] = None,
                  buckets: Optional[Sequence[int]] = None,
-                 clock=None):
+                 clock=None, serve_loop: Optional[str] = None):
         assert model.executor is not None, "call model.compile() first"
         config = model.config
         n = int(n_replicas or getattr(config, "fleet_replicas", 0) or 2)
@@ -445,7 +455,7 @@ class ServingFleet:
             FleetReplica(i, ServingEngine(
                 model, n_slots=n_slots, max_decode_len=max_decode_len,
                 buckets=buckets, max_queue=max_queue, eos_id=eos_id,
-                exact_decode=exact_decode),
+                exact_decode=exact_decode, serve_loop=serve_loop),
                 plan=(plans[i] if plans else None),
                 open_after=open_after)
             for i in range(n)]
@@ -625,6 +635,8 @@ class ServingFleet:
             rep.retired_host[0] += rep.loop.stats.host_dispatch_s
             rep.retired_host[1] += rep.loop.stats.host_device_s
             rep.retired_host[2] += rep.loop.stats.host_bookkeep_s
+            rep.retired_host[3] += rep.loop.stats.host_overlap_s
+            rep.retired_syncs += rep.loop.stats.host_syncs
         eng = rep.engine
         sched = ContinuousBatchScheduler(
             n_slots=eng.n_slots, max_queue=eng.max_queue,
@@ -886,6 +898,16 @@ class ServingFleet:
         inflight: List[Request] = []
         if sched is None:
             return [], []
+        # settle the async loop's in-flight decode step first: tokens
+        # already sampled on-device belong to the stream — migrating
+        # without committing them would fork it. A kill may leave the
+        # pending buffers dead; dropping them is then correct (the
+        # uncommitted step is simply lost, as on a real crash).
+        if rep.loop is not None:
+            try:
+                rep.loop.settle()
+            except Exception:  # noqa: BLE001 — dead device buffers
+                pass
         for slot, req in enumerate(list(sched.slots)):
             if req is not None:
                 sched.cancel_slot(slot)
@@ -1257,6 +1279,11 @@ class ServingFleet:
                 # (chaos/probes/dispatch above, hedge machinery below);
                 # the per-replica serve loops split their own tick wall
                 self._host_router_s += time.perf_counter() - t_iter
+                # under --serve-loop async each replica tick leaves one
+                # decode transfer in flight and returns immediately, so
+                # this plain round-robin already interleaves N replicas'
+                # device work on one host: replica i+1's dispatch and
+                # bookkeeping run while replica i's step is on the wire
                 for rep in self.replicas:
                     worked = self._tick_replica(rep) or worked
                 t_post = time.perf_counter()
@@ -1344,15 +1371,22 @@ class ServingFleet:
         st.host_dispatch_s = self._host_router_s
         st.host_device_s = 0.0
         st.host_bookkeep_s = 0.0
+        st.host_overlap_s = 0.0
+        st.host_syncs = 0
         for rep in self.replicas:
-            d, v, b = rep.retired_host
+            d, v, b, o = rep.retired_host
+            n = rep.retired_syncs
             if rep.loop is not None:
                 d += rep.loop.stats.host_dispatch_s
                 v += rep.loop.stats.host_device_s
                 b += rep.loop.stats.host_bookkeep_s
+                o += rep.loop.stats.host_overlap_s
+                n += rep.loop.stats.host_syncs
             st.host_dispatch_s += d
             st.host_device_s += v
             st.host_bookkeep_s += b
+            st.host_overlap_s += o
+            st.host_syncs += n
         self._merge_telemetry(st)
         tracer = self._tracer()
         if tracer.enabled and self.model.config.trace_file:

@@ -408,6 +408,13 @@ class ContinuousBatchScheduler:
         # hedge-loss cancellations (ISSUE 11): slots/queue entries freed
         # WITHOUT a terminal outcome — the winning twin owns the ledger
         self.cancelled = 0
+        # slot incarnation counters (ISSUE 17, the async serve loop):
+        # bumped on EVERY slot-freeing path. A commit that was dispatched
+        # against incarnation e of a slot must be discarded if the slot
+        # was recycled (finish/evict/quarantine/hedge-cancel) while its
+        # result was in flight — identity of the Request object alone is
+        # not enough, a quarantined request can re-enter the SAME slot
+        self.slot_epoch: List[int] = [0] * n_slots
 
     # ------------------------------------------------------------ admission
     @property
@@ -692,6 +699,7 @@ class ContinuousBatchScheduler:
         self.finished.append(req)
         self.slots[slot] = None
         self._free.append(slot)
+        self.slot_epoch[slot] += 1
         self.recycled += 1
         if self.on_slot_freed is not None:
             self.on_slot_freed(slot)
@@ -746,6 +754,7 @@ class ContinuousBatchScheduler:
         self._release_blocks(req, adopt=False)
         self.slots[slot] = None
         self._free.append(slot)
+        self.slot_epoch[slot] += 1
         self.quarantined += 1
         if self.rt.enabled:
             self.rt.note(req.rid, "quarantine", float(self.clock()),
@@ -768,6 +777,7 @@ class ContinuousBatchScheduler:
         self._release_blocks(req)
         self.slots[slot] = None
         self._free.append(slot)
+        self.slot_epoch[slot] += 1
         self.cancelled += 1
         if self.on_slot_freed is not None:
             self.on_slot_freed(slot)
