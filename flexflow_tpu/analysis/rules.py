@@ -332,7 +332,10 @@ def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
                    max_blocks_per_slot: int, max_context: int,
                    kv_layout: str = "replicated",
                    tp: int = 1,
-                   prefill_chunk_tokens: int = 0) -> List[Diagnostic]:
+                   prefill_chunk_tokens: int = 0,
+                   seq_shards: int = 1,
+                   n_devices: int = 1,
+                   context_buckets: Sequence[int] = ()) -> List[Diagnostic]:
     """FF006 extension (ISSUE 12; chunk laws ISSUE 14): static shape
     laws of a paged-KV serving configuration — judged with ZERO compile,
     so a misconfigured layout is rejected at engine construction (or
@@ -353,7 +356,15 @@ def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
       table would silently truncate a legal request's KV extent;
     * under a heads-sharded KV layout every attention node's head count
       must divide ``tp`` — the per-chip pool shard otherwise splits a
-      head's rows across chips.
+      head's rows across chips;
+    * sequence-parallel decode (ISSUE 18): ``seq_shards`` must divide
+      the block-table width evenly (each shard chip owns a contiguous
+      ``max_blocks_per_slot / seq_shards`` run — a ragged split would
+      give shards different compiled extents), every searched context
+      bucket must fit the table, and on a real mesh ``seq_shards`` must
+      divide the device count — composed with a heads-sharded layout,
+      ``tp * seq_shards`` must too (the seq axis multiplies the KV
+      grid, it does not replace it).
     """
     out: List[Diagnostic] = []
     hint = ("fix the paged-KV knobs (--kv-block-size / --kv-pool-blocks "
@@ -417,4 +428,50 @@ def check_paged_kv(pcg, *, block_size: int, pool_blocks: int,
                              "split a head across chips"),
                     fix_hint="use the replicated KV layout or a tp that "
                              "divides num_heads"))
+    shard_hint = ("pick --seq-shards so it divides the block-table "
+                  "width (--max-decode-len / --kv-block-size) and the "
+                  "mesh; size --context-buckets within the table")
+    if seq_shards < 1:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=(f"sequence-parallel decode: seq_shards must be "
+                     f">= 1 (got {seq_shards})"), fix_hint=shard_hint))
+        return out
+    if max_blocks_per_slot % seq_shards:
+        out.append(Diagnostic(
+            rule_id="FF006", node="",
+            message=(f"sequence-parallel decode: --seq-shards "
+                     f"({seq_shards}) must divide the block-table width "
+                     f"({max_blocks_per_slot} blocks) — each shard chip "
+                     "owns one contiguous equal run of a slot's blocks; "
+                     "a ragged split would give shards different "
+                     "compiled extents"), fix_hint=shard_hint))
+    for bucket in context_buckets:
+        if bucket > max_blocks_per_slot * block_size:
+            out.append(Diagnostic(
+                rule_id="FF006", node="",
+                message=(f"sequence-parallel decode: context bucket "
+                         f"{bucket} exceeds the block table's "
+                         f"{max_blocks_per_slot * block_size}-token "
+                         f"extent ({max_blocks_per_slot} blocks x "
+                         f"{block_size}) — requests routed to it could "
+                         "never hold their KV"), fix_hint=shard_hint))
+    if seq_shards > 1 and n_devices > 1:
+        if n_devices % seq_shards:
+            out.append(Diagnostic(
+                rule_id="FF006", node="",
+                message=(f"sequence-parallel decode: --seq-shards "
+                         f"({seq_shards}) must divide the mesh "
+                         f"({n_devices} devices) — the seq axis is a "
+                         "mesh axis, not a remainder"),
+                fix_hint=shard_hint))
+        elif kv_layout == "sharded" and n_devices % (tp * seq_shards):
+            out.append(Diagnostic(
+                rule_id="FF006", node="",
+                message=(f"sequence-parallel decode: composed KV grid "
+                         f"tp x seq_shards ({tp} x {seq_shards} = "
+                         f"{tp * seq_shards}) must divide the mesh "
+                         f"({n_devices} devices) — the seq axis "
+                         "multiplies the heads-sharded layout, it does "
+                         "not replace it"), fix_hint=shard_hint))
     return out

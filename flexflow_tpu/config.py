@@ -292,6 +292,19 @@ class FFConfig:
     # is in flight, commit at arrival — bitwise the sync streams under
     # exact decode, at a lower host_overhead_fraction)
     serve_loop: str = "sync"
+    # sequence-parallel decode (flexflow_tpu/kernels/seqpar_decode.py,
+    # docs/decode_perf.md "Sequence-parallel decode"; ISSUE 18): number
+    # of contiguous block-table shards a slot's KV extent is scored
+    # across per decode step — the capacity axis for contexts whose
+    # paged KV exceeds one chip's HBM. 1 = unsharded (the reference
+    # path); requires the paged layout; refused by speculative decoding
+    # (SeqShardsError)
+    seq_shards: int = 1
+    # context-length buckets the serving search prices seq_shards for
+    # ("1024,4096,16384" — strictly ascending token counts; admission
+    # routes each request to the smallest covering bucket). Empty = no
+    # bucketing (one shard width for everything)
+    context_buckets: str = ""
     # serving fleet (flexflow_tpu/serving/fleet.py, docs/fleet.md;
     # ISSUE 11). Replica count of the multi-replica router: N independent
     # fault domains behind load-aware dispatch with health-checked
@@ -552,6 +565,18 @@ class FFConfig:
                     raise ValueError(
                         f"--serve-loop expects sync|async, got {v!r}")
                 self.serve_loop = v
+            elif a == "--seq-shards":
+                self.seq_shards = int(_next())
+                if self.seq_shards < 1:
+                    raise ValueError(
+                        f"--seq-shards expects an integer >= 1, got "
+                        f"{self.seq_shards}")
+            elif a == "--context-buckets":
+                from .serving.kvcache import parse_context_buckets
+
+                v = _next()
+                parse_context_buckets(v)  # fail fast at parse time
+                self.context_buckets = v
             elif a == "--fleet-replicas":
                 self.fleet_replicas = int(_next())
             elif a == "--hedge-after-pctl":
@@ -699,6 +724,18 @@ class FFConfig:
                 f"--decode-retry-budget must be >= 0 (got "
                 f"{self.decode_retry_budget}); 0 aborts a poisoned "
                 "request on its first quarantined decode")
+        if "--seq-shards" in seen and self.seq_shards > 1 and \
+                self.kv_cache == "ring":
+            raise ValueError(
+                "--seq-shards > 1 requires --kv-cache paged (the ring "
+                "layout has no block tables to partition into per-shard "
+                "contiguous runs)")
+        if "--context-buckets" in seen and self.context_buckets and \
+                self.kv_cache == "ring":
+            raise ValueError(
+                "--context-buckets requires --kv-cache paged (buckets "
+                "route requests to sequence-sharded block-table "
+                "partitions)")
         if "--fleet-replicas" in seen and self.fleet_replicas < 0:
             raise ValueError(
                 f"--fleet-replicas must be >= 0 (got "

@@ -975,7 +975,7 @@ class Executor:
 
     def make_decode_step(self, max_decode_len: int, exact: bool = False,
                          guard: bool = False, block_size: int = 0,
-                         kv_dtype: str = "native"):
+                         kv_dtype: str = "native", seq_shards: int = 1):
         """Jitted ``(params, xs, state) -> (logits, new_state)``: ONE token
         per slot through the graph, consuming and extending the
         ``DecodeState`` ring buffers at each slot's ``lengths`` cursor.
@@ -999,11 +999,17 @@ class Executor:
         tables, ``block_size``/``kv_dtype`` select the paged layout —
         the tables ride the jitted signature as one more int32 array, so
         the single-compile contract is unchanged (ring and paged are
-        distinct programs, each compiled once)."""
+        distinct programs, each compiled once).
+
+        ``seq_shards`` (ISSUE 18) selects the sequence-parallel decode
+        decomposition (ServingState.seq_shards): the gathered extent is
+        scored as that many contiguous key segments merged by the flash
+        segment combine — a static trace-time choice, so it joins the
+        jit key and keeps the single-compile contract."""
         import jax
 
         key = ("decode", int(max_decode_len), bool(exact), bool(guard),
-               int(block_size), str(kv_dtype))
+               int(block_size), str(kv_dtype), int(seq_shards))
         cached = self._serving_jits.get(key)
         if cached is not None:
             return cached
@@ -1022,7 +1028,8 @@ class Executor:
                               cache_in=state.caches, exact=exact,
                               block_tables=state.block_tables,
                               block_size=int(block_size),
-                              kv_dtype=str(kv_dtype))
+                              kv_dtype=str(kv_dtype),
+                              seq_shards=int(seq_shards))
             ctx = OpContext(training=False, rng=None, mesh=mesh,
                             profiling=profiling, serving=sv)
             values = self.forward_outputs(

@@ -51,7 +51,25 @@ FUSED_BWD_RESIDENT_BUDGET = 5 * 2 ** 20
 # Unroll the fused backward's q loop with STATIC slices up to this many
 # tiles (dynamic-slice reads defeat the Mosaic vectorizer, ~10% on v5e).
 MAX_UNROLL_QB = 16
+# Per-core VMEM scope the backward schedules must fit inside a full train
+# step (v5e/v5p expose 16 MB to a Pallas kernel next to XLA's own buffers).
+VMEM_SCOPE_BYTES = 16 * 2 ** 20
 NEG_INF = -1e30
+
+
+def _fused_bwd_vmem_bytes(seq_q: int, d: int, block_q: int,
+                          block_k: int) -> int:
+    """VMEM footprint of the fused one-pass backward at a given tiling:
+    the resident Q/dO/O/dq-out (bf16) plus the (seq_q, d) f32 dq scratch
+    (~10*seq_q*d bytes), three (block_q, block_k) f32 score-sized tiles in
+    flight (s, p, dp), and the streamed K/V bf16 tiles. Used to decide when
+    the k tile can be WIDER than the conservative 512 cap: short sequences
+    leave most of the scope unused, and wider k tiles amortize the resident
+    re-reads across fewer grid steps."""
+    resident = 10 * seq_q * d
+    score_tiles = 3 * block_q * block_k * 4
+    kv_tiles = 2 * block_k * d * 2
+    return resident + score_tiles + kv_tiles
 
 
 def dropout_keep_scale(seed, bh, q_start, k_start, block_q, block_k,
@@ -662,30 +680,52 @@ def _flash_attention_p(q, k, v, seed, causal, block_q, block_k, interpret,
 
 
 def _bwd_blocks(block_q: int, block_k: int, bwd_block_q, bwd_block_k,
-                seq_q: int, seq_k: int):
-    """Backward defaults to the forward blocks with block_k capped at 512:
-    the fused backward keeps three (block_q, block_k) f32 score-sized tiles
-    in flight plus the dq scratch, so 1024-wide k tiles (the forward sweet
-    spot) overflow the 16 MB VMEM scope inside a full train step — and
-    (512, 512) measured the same 2.16 ms/layer as (512, 1024) on v5e.
+                seq_q: int, seq_k: int, head_dim: Optional[int] = None):
+    """Backward block defaults are SCHEDULE-AWARE (r18):
 
-    Divisibility is re-checked against the sequences: a capped default that
-    no longer divides seq_k falls back to the (valid) forward block, and an
+    - Fused one-pass (seq_q*d*10 <= FUSED_BWD_RESIDENT_BUDGET): keeps three
+      (block_q, block_k) f32 score-sized tiles in flight NEXT TO the
+      resident Q/dO/O/dq, so block_k defaults to the measured 512 cap —
+      (512, 512) timed the same 2.16 ms/layer as (512, 1024) on v5e —
+      UNLESS _fused_bwd_vmem_bytes says the forward-width tile still fits
+      the 16 MB scope (short sequences), in which case the wider forward
+      block wins back the resident re-read amortization.
+    - Two-pass streaming (past the residency budget): VMEM is O(block),
+      so the k tile defaults to the full forward block — 1024-wide k tiles
+      are the forward sweet spot and the long-context (8k-32k) backward
+      spends its time streaming K/V, where wider tiles cut grid overhead.
+
+    Without head_dim (legacy callers) the conservative 512 cap applies.
+
+    Divisibility is re-checked against the sequences: a default that no
+    longer divides seq_k falls back to the (valid) forward block, and an
     EXPLICIT non-dividing override raises — the grid floor-divisions would
     otherwise silently drop the tail keys from dk/dv/dq."""
-    out = []
-    for name, override, fwd_blk, default, seq in (
-            ("bwd_block_q", bwd_block_q, block_q, block_q, seq_q),
-            ("bwd_block_k", bwd_block_k, block_k, min(block_k, 512), seq_k)):
-        blk = override if override is not None else default
-        if seq % min(blk, seq) != 0:
-            if override is not None:
-                raise ValueError(
-                    f"flash_attention {name}={blk} does not divide "
-                    f"sequence length {seq}")
-            blk = fwd_blk  # forward block divides by the public contract
-        out.append(blk)
-    return tuple(out)
+    bq = bwd_block_q if bwd_block_q is not None else block_q
+    if seq_q % min(bq, seq_q) != 0:
+        if bwd_block_q is not None:
+            raise ValueError(
+                f"flash_attention bwd_block_q={bq} does not divide "
+                f"sequence length {seq_q}")
+        bq = block_q  # forward block divides by the public contract
+
+    k_default = min(block_k, 512)
+    if head_dim is not None:
+        fused = seq_q * head_dim * 10 <= FUSED_BWD_RESIDENT_BUDGET
+        if not fused:
+            k_default = block_k
+        elif _fused_bwd_vmem_bytes(seq_q, head_dim, min(bq, seq_q),
+                                   block_k) <= VMEM_SCOPE_BYTES:
+            k_default = block_k
+
+    bk = bwd_block_k if bwd_block_k is not None else k_default
+    if seq_k % min(bk, seq_k) != 0:
+        if bwd_block_k is not None:
+            raise ValueError(
+                f"flash_attention bwd_block_k={bk} does not divide "
+                f"sequence length {seq_k}")
+        bk = block_k
+    return bq, bk
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -747,7 +787,7 @@ def _bwd(causal, block_q, block_k, interpret, dropout, bwd_block_q,
     mask regenerated from the same counters)."""
     q, k, v, seed, out, lse = res
     bq, bk = _bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k,
-                         q.shape[-2], k.shape[-2])
+                         q.shape[-2], k.shape[-2], q.shape[-1])
     dq, dk, dv = _flash_backward(q, k, v, out, lse, do, causal, bq,
                                  bk, _resolve_interpret(interpret),
                                  dropout=dropout, seed=seed)

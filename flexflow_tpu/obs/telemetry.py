@@ -134,6 +134,11 @@ class StepTelemetry:
         # HOST spent dispatching + bookkeeping (vs blocked on the device)
         # — the ROADMAP "host overhead" baseline, per engine and fleet
         self.serving_host_overhead_fraction: Optional[float] = None
+        # sequence-parallel decode (ISSUE 18): mean per-step occupied KV
+        # bytes one shard chip holds (pool bytes at measured fill /
+        # seq_shards) — the recorded number behind "KV provably exceeds
+        # one chip"
+        self.serving_kv_hbm_per_chip_bytes: Optional[int] = None
         # serving-resilience counters (ISSUE 9): the outcome ledger of a
         # serve() run (every request under exactly one of ok |
         # deadline_exceeded | shed | decode_fault | preempted) plus the
@@ -312,6 +317,9 @@ class StepTelemetry:
             if self.serving_host_overhead_fraction is not None:
                 sv["host_overhead_fraction"] = round(
                     self.serving_host_overhead_fraction, 4)
+            if self.serving_kv_hbm_per_chip_bytes is not None:
+                sv["kv_hbm_per_chip_bytes"] = \
+                    int(self.serving_kv_hbm_per_chip_bytes)
             out["serving"] = sv
         if self.fleet_replicas:
             total = max(sum(self.fleet_outcomes.values()), 1)

@@ -262,6 +262,8 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
         vc = write_token_kv(vc, v, sv.positions)
         sv.cache_out[name] = (kc, vc)
     extent = kc.shape[2]  # max_len (ring) | blocks * block_size (paged)
+    if sv.seq_shards > 1:
+        return _seqpar_decode(q, kc, vc, sv, scale, extent)
     if sv.exact:
         # bitwise mode: the 1-token q rides a full-extent score GEMM (its
         # row is extracted afterwards) so the d-axis accumulation order
@@ -284,6 +286,68 @@ def _serving_attention(name: str, q, k, v, sv, *, causal: bool):
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,
                      preferred_element_type=jnp.float32)
     return out.astype(vc.dtype)
+
+
+def _seqpar_decode(q, kc, vc, sv, scale, extent):
+    """Sequence-parallel decode step (ISSUE 18): the gathered extent is
+    partitioned into ``sv.seq_shards`` contiguous key segments — on a
+    mesh each segment is one chip's run of pool blocks; on a single
+    device the same decomposition runs locally, which is what tier-1
+    pins.
+
+    ``exact`` keeps the bitwise contract against the single-shard
+    reference: every shard scores the SAME full-extent padded q against
+    its key segment, and the score einsum never reduces over the key
+    axis — shard s's columns are elementwise the unsharded GEMM's
+    columns ``[s*seg, (s+1)*seg)``, so concatenating in position order
+    reproduces the single-shard logits bit-for-bit and one unsharded
+    softmax/PV finishes the step (the combine collective carries raw
+    score columns instead of (m, l, acc) in this audit mode).
+
+    The fast path is the deployable layout: each shard folds its
+    segment through the flash-decode online-softmax recurrence into a
+    partial ``(m, l, acc)`` and the priced segment-merge combines them
+    (kernels/seqpar_decode.py) — ~1 ulp from the single-shard fast
+    matvec, the same band the fast-vs-exact delta already occupies.
+    Fully-masked segments (write cursor below the shard's range)
+    contribute exact zeros via ``exp(-1e30 - m*)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..kernels.seqpar_decode import (combine_partials,
+                                         decode_shard_partial,
+                                         shard_segment)
+    from ..serving.kvcache import write_token_kv
+
+    S = int(sv.seq_shards)
+    seg = shard_segment(extent, S)
+    kpos = jnp.arange(extent)
+    mask = kpos[None, None, None, :] <= sv.positions[:, None, None, None]
+    if sv.exact:
+        qpad = write_token_kv(
+            jnp.zeros(kc.shape[:2] + (extent, q.shape[-1]), q.dtype),
+            q, sv.positions)
+        cols = []
+        for s in range(S):
+            kseg = lax.slice_in_dim(kc, s * seg, (s + 1) * seg, axis=2)
+            full = jnp.einsum("bhqd,bhkd->bhqk", qpad, kseg,
+                              preferred_element_type=jnp.float32) * scale
+            cols.append(jnp.take_along_axis(
+                full, sv.positions[:, None, None, None], axis=2))
+        logits = jnp.where(mask, jnp.concatenate(cols, axis=-1), -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vc.dtype), vc,
+                         preferred_element_type=jnp.float32)
+        return out.astype(vc.dtype)
+    partials = []
+    for s in range(S):
+        lo, hi = s * seg, (s + 1) * seg
+        partials.append(decode_shard_partial(
+            q, lax.slice_in_dim(kc, lo, hi, axis=2),
+            lax.slice_in_dim(vc, lo, hi, axis=2),
+            mask[..., lo:hi], scale))
+    return combine_partials(partials).astype(vc.dtype)
 
 
 def _chunk_prefill_attention(name: str, q, k, v, sv):
@@ -384,7 +448,11 @@ def _maybe_flash_decode(q, entry, tables, sv, sm_scale):
     chip generation warns once for THIS kernel (ISSUE 12 satellite)."""
     from ..kernels.flash_decode import flash_decode, use_flash_decode
 
-    if sv.exact or not use_flash_decode(q.shape[-1], sv.block_size):
+    if (sv.exact or sv.seq_shards > 1
+            or not use_flash_decode(q.shape[-1], sv.block_size)):
+        # seq_shards > 1: the shard decomposition runs the split-K math
+        # per segment over the gathered extent (_seqpar_decode); the
+        # single whole-extent kernel launch would bypass the combine
         return None
     _flash_tuning(kernel="flash_decode")  # per-(generation, kernel) warn
     n_keys = sv.positions + 1

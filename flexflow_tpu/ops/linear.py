@@ -15,10 +15,43 @@ sharding of one weight dim:
 from __future__ import annotations
 
 
+import jax
 import numpy as np
 
 from ..ffconst import ActiMode, OperatorType
 from .base import Op, OpContext, register_op
+
+
+@jax.custom_vjp
+def bias_add(y, b):
+    """Broadcast bias add with a layout-friendly gradient.
+
+    The naive ``y + b`` backward asks XLA to reduce dy over EVERY leading
+    axis at once; at bf16 that lowers to the multi-axis convert+reduce
+    fusion that showed up as 2.2 ms/step of the r05 seq-4096 baseline
+    (it re-reads dy once per reduced axis in a minor-dim-hostile order).
+    The custom backward collapses the leading axes FIRST — one reshape to
+    (rows, out_dim), which is free on a row-major layout — then does a
+    single-axis f32 column reduce, the shape the TPU reducer streams at
+    full HBM bandwidth."""
+    return y + b
+
+
+def _bias_add_fwd(y, b):
+    # residual is the (out_dim,) bias itself — only its dtype is consumed,
+    # but a raw numpy dtype is not a pytree leaf JAX transforms accept
+    return y + b, b
+
+
+def _bias_add_bwd(b, g):
+    import jax.numpy as jnp
+
+    rows = g.reshape(-1, g.shape[-1])
+    db = jnp.sum(rows.astype(jnp.float32), axis=0).astype(b.dtype)
+    return g, db
+
+
+bias_add.defvjp(_bias_add_fwd, _bias_add_bwd)
 
 
 def apply_activation(x, activation: ActiMode):
@@ -89,7 +122,7 @@ class LinearOp(Op):
         y = jnp.dot(x, kernel, preferred_element_type=jnp.float32)
         y = y.astype(x.dtype)
         if "bias" in params:
-            y = y + params["bias"]
+            y = bias_add(y, params["bias"])
         apply_weight_regularizer(self.attrs.get("kernel_regularizer"),
                                  kernel, ctx)
         return [apply_activation(y, self.attrs.get("activation",

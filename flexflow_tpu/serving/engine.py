@@ -130,6 +130,13 @@ class ServingStats:
     # steady-state contract is <= 1 per committed decode step
     host_overlap_s: float = 0.0
     host_syncs: int = 0
+    # sequence-parallel decode (ISSUE 18): mean per-step occupied KV
+    # bytes ONE shard chip holds — pool bytes at measured fill divided
+    # by seq_shards. This is the recorded number behind the "KV provably
+    # exceeds one chip" criterion: the bench asserts the undivided total
+    # is above a real chip's HBM budget while this per-chip figure is
+    # below it. Set at serve-loop finish; 0 until a decode step ran.
+    kv_hbm_per_chip_bytes: int = 0
 
     def record_token(self, wall_s: float) -> None:
         self.token_walls_s.append(wall_s)
@@ -235,6 +242,8 @@ class ServingStats:
             out["host_overhead_fraction"] = round(hof, 4)
         if self.host_syncs:
             out["host_syncs"] = self.host_syncs
+        if self.kv_hbm_per_chip_bytes:
+            out["kv_hbm_per_chip_bytes"] = self.kv_hbm_per_chip_bytes
         return out
 
 
@@ -263,7 +272,9 @@ class ServingEngine:
                  prefix_cache: Optional[str] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_cache_blocks: Optional[int] = None,
-                 serve_loop: Optional[str] = None):
+                 serve_loop: Optional[str] = None,
+                 seq_shards: Optional[int] = None,
+                 context_buckets: Optional[Sequence[int]] = None):
         assert model.executor is not None, "call model.compile() first"
         self.model = model
         self.executor = model.executor
@@ -305,7 +316,8 @@ class ServingEngine:
             raise ValueError(
                 f"kv_cache must be 'paged' or 'ring', got "
                 f"{self.kv_cache!r}")
-        from .kvcache import KV_DTYPES, blocks_per_slot
+        from .kvcache import (KV_DTYPES, SeqShardsError, blocks_per_slot,
+                              parse_context_buckets)
 
         if self.kv_dtype not in KV_DTYPES:
             raise ValueError(
@@ -315,6 +327,31 @@ class ServingEngine:
             raise ValueError(
                 "kv_dtype='int8' requires the paged KV layout "
                 "(kv_cache='paged')")
+        # sequence-parallel decode (ISSUE 18, docs/decode_perf.md
+        # "Sequence-parallel decode"): the gathered extent is scored as
+        # seq_shards contiguous key segments merged by the flash segment
+        # combine — a static trace-time choice that joins the decode jit
+        # key. context_buckets routes admitted requests to the searched
+        # per-bucket shard width (serving_search picks seq_shards per
+        # bucket from the ICI closed forms).
+        self.seq_shards = int(seq_shards if seq_shards is not None
+                              else getattr(cfg, "seq_shards", 1) or 1)
+        if self.seq_shards < 1:
+            raise ValueError(
+                f"seq_shards must be >= 1, got {self.seq_shards}")
+        if self.seq_shards > 1 and self.kv_cache == "ring":
+            raise SeqShardsError(
+                "--seq-shards > 1 requires the paged KV layout "
+                "(kv_cache='paged'): the ring layout has no block tables "
+                "to partition into per-shard contiguous runs")
+        self.context_buckets = parse_context_buckets(
+            context_buckets if context_buckets is not None
+            else getattr(cfg, "context_buckets", "") or "")
+        if self.context_buckets and self.kv_cache == "ring":
+            raise ValueError(
+                "--context-buckets requires the paged KV layout "
+                "(kv_cache='paged'): buckets route requests to "
+                "sequence-sharded block-table partitions")
         # prefix cache + chunked prefill (ISSUE 14, serving/prefix.py,
         # docs/serving.md "Prefix cache & chunked prefill"): the radix
         # trie defaults ON for paged attention-only graphs — its hit
@@ -386,13 +423,18 @@ class ServingEngine:
             from ..analysis import (AnalysisReport, StaticAnalysisError,
                                     check_paged_kv)
 
+            import jax
+
             diags = check_paged_kv(
                 self.executor.pcg,
                 block_size=self.kv_block_size,
                 pool_blocks=self.kv_pool_blocks,
                 max_blocks_per_slot=mb,
                 max_context=self.max_context,
-                prefill_chunk_tokens=self.prefill_chunk_tokens)
+                prefill_chunk_tokens=self.prefill_chunk_tokens,
+                seq_shards=self.seq_shards,
+                n_devices=jax.device_count(),
+                context_buckets=self.context_buckets)
             if diags:
                 raise StaticAnalysisError(
                     AnalysisReport(diagnostics=diags, checked=("FF006",)),
@@ -524,7 +566,8 @@ class ServingEngine:
         fn = self.executor._serving_jits.get(
             ("decode", self.max_decode_len, self.exact_decode,
              self._last_guard,
-             self.kv_block_size if self._paged else 0, self.kv_dtype))
+             self.kv_block_size if self._paged else 0, self.kv_dtype,
+             self.seq_shards))
         if fn is None:
             return None
         try:
@@ -537,7 +580,7 @@ class ServingEngine:
         return self.executor.make_decode_step(
             self.max_decode_len, exact=self.exact_decode, guard=guard,
             block_size=self.kv_block_size if self._paged else 0,
-            kv_dtype=self.kv_dtype)
+            kv_dtype=self.kv_dtype, seq_shards=self.seq_shards)
 
     def _prefill_fn(self, bucket: int):
         return self.executor.make_prefill_step(bucket, self.max_decode_len)
@@ -901,12 +944,30 @@ class ServingEngine:
         next ``serve()`` consumes — a pre-serve shed or deadline stamp is
         never lost to a throwaway."""
         self._attach_kv_accounting(sched)
+        self._stamp_context_bucket(req)
         res = resilience
         if res is None:
             if self._pending_resilience is None:
                 self._pending_resilience = self._make_resilience(None)
             res = self._pending_resilience
         res.admit(sched, req)
+
+    def _stamp_context_bucket(self, req: Request) -> None:
+        """Admission half of the ISSUE 18 context-length routing: stamp
+        the request with the smallest searched bucket covering its max
+        context (prompt + decode budget); beyond every bucket it takes
+        the largest — mirroring ``ServingPlan.seq_shards_for``, so the
+        stamped bucket is the one whose searched seq_shards the request
+        decodes under. No-op without buckets (or if already stamped by
+        a router upstream)."""
+        if not self.context_buckets or req.context_bucket is not None:
+            return
+        need = int(req.prompt_len + req.max_new_tokens)
+        for b in self.context_buckets:
+            if need <= b:
+                req.context_bucket = b
+                return
+        req.context_bucket = self.context_buckets[-1]
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32, temperature: float = 0.0,
@@ -938,6 +999,7 @@ class ServingEngine:
                         max_new_tokens=max_new_tokens,
                         eos_id=self.eos_id if eos_id is None else eos_id,
                         rng_tag=i, deadline_ms=deadline_ms)
+            self._stamp_context_bucket(r)
             try:
                 res.admit(sched, r)
             except ServingRejection:
@@ -1218,6 +1280,10 @@ class ServingEngine:
         tel.serving_tokens_per_s = round(stats.tokens_per_s(), 2)
         # host-overhead accounting (ISSUE 16, ROADMAP item 5)
         tel.serving_host_overhead_fraction = stats.host_overhead_fraction()
+        # per-shard-chip KV residency (ISSUE 18) — only once a decode
+        # step measured the fill
+        tel.serving_kv_hbm_per_chip_bytes = \
+            stats.kv_hbm_per_chip_bytes or None
         # serving_resilience block (ISSUE 9): the outcome ledger + event
         # counters, mirroring the resilience/strategy_safety blocks
         tel.serving_outcomes = dict(stats.outcomes)
@@ -1802,6 +1868,14 @@ class _ServeLoop:
         if eng._prefix is not None:
             stats.cache_evictions = \
                 eng._prefix.evictions - self._evictions0
+        # per-shard-chip KV residency (ISSUE 18): mean per-step occupied
+        # KV bytes / seq_shards — each shard chip holds one contiguous
+        # 1/seq_shards run of every slot's blocks, so the measured-fill
+        # pool bytes divide evenly across the seq mesh axis
+        if stats.decode_steps and stats.kv_bytes_read:
+            stats.kv_hbm_per_chip_bytes = int(
+                stats.kv_bytes_read / stats.decode_steps
+                / max(eng.seq_shards, 1))
         if self.publish_telemetry:
             eng._merge_telemetry(sched, stats)
             if tracer.enabled and eng.model.config.trace_file:

@@ -66,6 +66,14 @@ KV_DTYPES = ("native", "int8")
 INT8_QMAX = 127.0
 
 
+class SeqShardsError(ValueError):
+    """A configuration asked for sequence-parallel decode
+    (``--seq-shards`` > 1) in a mode that cannot honor it — the ring KV
+    layout (no block tables to partition) or speculative decoding (the
+    greedy verify contract assumes the single-shard score path). Raised
+    loudly at plan/engine construction instead of decoding garbage."""
+
+
 @dataclasses.dataclass
 class ServingState:
     """Per-forward serving context threaded as ``OpContext.serving``.
@@ -101,6 +109,14 @@ class ServingState:
     block_size: tokens per KV block (paged layout only)
     kv_dtype:  "native" (store k/v at the model dtype) or "int8"
                (symmetric per-(token, head) quantization with f32 scales)
+    seq_shards: sequence-parallel decode width (ISSUE 18) — the gathered
+               KV extent is partitioned into this many contiguous key
+               segments, each scored independently (on a mesh: one chip
+               per shard owning that run of pool blocks; on one device:
+               an emulated compute-path decomposition of the same
+               arrays) and merged by the flash segment combine. 1 is
+               the unsharded reference path. Paged decode only; chunk
+               prefill writes are layout-identical at any width.
     """
 
     mode: str
@@ -113,6 +129,7 @@ class ServingState:
     block_tables: Any = None
     block_size: int = 0
     kv_dtype: str = "native"
+    seq_shards: int = 1
 
     @property
     def paged(self) -> bool:
@@ -166,6 +183,40 @@ _register_pytree()
 
 
 # ---------------------------------------------------------------- helpers
+def parse_context_buckets(spec) -> Tuple[int, ...]:
+    """Normalize a ``--context-buckets`` spec — the comma-separated flag
+    string ("1024,4096,16384") or an already-parsed int sequence — into
+    a validated ascending tuple of context lengths. Each bucket is the
+    max context a request routed to it may hold; ``serving_search``
+    picks seq_shards per bucket and admission routes a request to the
+    smallest bucket covering its context. Empty spec → no bucketing."""
+    if not spec:
+        return ()
+    if isinstance(spec, str):
+        vals = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                vals.append(int(part))
+            except ValueError:
+                raise ValueError(
+                    f"--context-buckets: {part!r} is not an integer "
+                    "(expected a comma-separated list like "
+                    "'1024,4096,16384')")
+    else:
+        vals = [int(v) for v in spec]
+    if any(v < 1 for v in vals):
+        raise ValueError(
+            f"--context-buckets entries must be >= 1, got {vals}")
+    if vals != sorted(set(vals)):
+        raise ValueError(
+            "--context-buckets must be strictly ascending context "
+            f"lengths, got {vals}")
+    return tuple(vals)
+
+
 def is_position_constant(value) -> bool:
     """Detect the position-id constant pattern the autoregressive builders
     bake in (models/gpt2.py: ``broadcast(arange(seq_len), (b, s))``): an

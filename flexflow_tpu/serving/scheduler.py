@@ -270,6 +270,12 @@ class Request:
     chunk_shape: int = 0
     pending_cow: Optional[Tuple[int, int]] = None
     finish_ms: float = 0.0
+    # sequence-parallel decode (ISSUE 18): the searched context-length
+    # bucket this request was routed to at admission (None = engine has
+    # no --context-buckets) — the bucket whose seq_shards the plan's
+    # ``seq_shards_for`` picked; the fleet router and trace digest read
+    # it back
+    context_bucket: Optional[int] = None
 
     @property
     def prefilling(self) -> bool:

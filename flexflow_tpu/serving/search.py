@@ -102,9 +102,27 @@ class ServingPlan:
     # expected prefill-token reuse fraction the p99 was priced at
     # (ISSUE 14: measured prefix-cache hit rate, or an assumption)
     prefill_reuse: float = 0.0
+    # sequence-parallel decode (ISSUE 18): the searched context-length
+    # buckets and the seq_shards the ICI closed forms picked for each —
+    # admission routes a request to its bucket (``seq_shards_for``)
+    context_buckets: Tuple[int, ...] = ()
+    seq_shards_by_bucket: Dict[int, int] = dataclasses.field(
+        default_factory=dict)
     assignment: Dict[int, object] = dataclasses.field(default_factory=dict)
     ranked: List[ServingCandidate] = dataclasses.field(default_factory=list)
     sim: object = None  # the warm Simulator (elastic re-search reuse)
+
+    def seq_shards_for(self, context_len: int) -> int:
+        """Admission routing: the searched seq_shards of the smallest
+        bucket covering ``context_len`` (requests beyond every bucket
+        take the largest — they must shard hardest); 1 when the search
+        ran without buckets."""
+        if not self.context_buckets:
+            return 1
+        for b in self.context_buckets:
+            if context_len <= b:
+                return self.seq_shards_by_bucket.get(b, 1)
+        return self.seq_shards_by_bucket.get(self.context_buckets[-1], 1)
 
     def describe(self) -> str:
         return (f"mesh={tuple(self.mesh_shape)} kv={self.layout} "
@@ -279,13 +297,91 @@ def _graph_cost(sim, g: PCG, tp: int, kv_div: int, slots: int,
     return t + comm + kv_time, mem_w + kv_bytes + transient, assignment
 
 
+def _bucket_seq_shards(pcg: PCG, machine, n_dev: int, slots: int,
+                       bucket: int, kv_dtype: str,
+                       kv_fill: float) -> Tuple[int, float, float, bool]:
+    """Searched seq_shards for ONE context bucket (ISSUE 18): sweep the
+    power-of-two shard widths dividing the mesh and pick the one
+    minimizing the per-decode-step KV stream + ring-combine time from
+    the ICI closed forms — the same pricing vocabulary as kv_fill/
+    prefill_reuse, next to which this axis sits in the objective.
+
+    Per shard width ``s``:
+
+    * the bucket's KV read splits s ways and streams in parallel —
+      ``t_kv = kv_read(bucket) / s / (hbm_bw * hbm_eff)``;
+    * the combine pays two allgathers per attention node per step: the
+      step's query rows out to every shard, the f32 ``(m, l, acc)``
+      partial triples back (kernels/seqpar_decode.py byte helpers);
+      widths spanning pods compose via ``hier_allgather_time`` (the
+      PR 15 DCN x ICI law);
+    * feasibility: one shard chip's share of the bucket's FULL-extent
+      KV must fit its HBM (capacity is judged at worst case, like the
+      sweep's memory term).
+
+    Returns ``(seq_shards, t_kv_s, t_combine_s, fits)``; when no width
+    fits, the widest is returned with ``fits=False`` — the least-bad
+    plan, flagged rather than hidden."""
+    from ..kernels.seqpar_decode import (combine_bytes_per_step,
+                                         query_bytes_per_step)
+    from .kvcache import kv_token_bytes
+
+    nodes = [n for n in pcg.compute_nodes()
+             if n.op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION]
+    if not nodes:
+        return 1, 0.0, 0.0, True
+    fill = max(min(float(kv_fill), 1.0), 0.0)
+    kv_cap = 0
+    dims = []
+    for node in nodes:
+        a = node.op.attrs
+        heads = int(a.get("num_heads", 1))
+        kdim = int(a.get("kdim") or a["embed_dim"] // heads)
+        vdim = int(a.get("vdim") or a["embed_dim"] // heads)
+        el = size_of_datatype(node.op.data_type)
+        kv_cap += slots * bucket * kv_token_bytes(
+            heads, kdim, vdim, el, kv_dtype)
+        dims.append((heads, kdim, vdim, el))
+    hbm_stream = machine.hbm_bandwidth * machine.hbm_efficiency
+    widths = []
+    s = 1
+    while s <= n_dev:
+        if n_dev % s == 0:
+            widths.append(s)
+        s *= 2
+    best = None
+    widest = None
+    for s in widths:
+        t_kv = kv_cap * fill / s / hbm_stream
+        t_comb = 0.0
+        if s > 1:
+            cpp = machine.chips_per_pod
+            for heads, kdim, vdim, el in dims:
+                qb = query_bytes_per_step(heads, kdim, slots, el)
+                pb = combine_bytes_per_step(heads, vdim, slots, s)
+                if s > cpp and s % cpp == 0:
+                    t_comb += machine.hier_allgather_time(qb, cpp, s // cpp)
+                    t_comb += machine.hier_allgather_time(pb, cpp, s // cpp)
+                else:
+                    t_comb += machine.allgather_time(qb, s)
+                    t_comb += machine.allgather_time(pb, s)
+        fits = kv_cap // s <= machine.hbm_capacity
+        cand = (s, t_kv, t_comb, fits)
+        widest = cand
+        if fits and (best is None or
+                     t_kv + t_comb < best[1] + best[2] - 1e-12):
+            best = cand
+    return best if best is not None else widest
+
+
 # --------------------------------------------------------------- top level
 def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                    sim=None, max_inflight: Optional[int] = None,
                    max_decode_len: Optional[int] = None,
                    slo_p99_ms: Optional[float] = None,
                    kv_fill: float = 1.0,
-                   prefill_reuse: float = 0.0) -> ServingPlan:
+                   prefill_reuse: float = 0.0,
+                   context_buckets=None) -> ServingPlan:
     """Latency-bounded throughput search over (dp, tp, KV layout,
     kv_dtype) for the decode graph (kv_dtype ∈ {native, int8} is the
     ISSUE 12 precision-for-bandwidth axis; ``--kv-dtype`` pins it
@@ -299,7 +395,14 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
     (``ServingStats.prefix_reuse_rate``, what ``elastic_replan``
     feeds) or assumed — scales the p99 prefill stall term, so a
     high-hit-rate fleet stops over-providing for a cold-cache worst
-    case the SLO never sees."""
+    case the SLO never sees.
+
+    ``context_buckets`` (ISSUE 18) makes context-length bucketing a
+    searched axis: for each bucket (defaulted from
+    ``config.context_buckets``) the objective picks seq_shards from the
+    ICI closed forms (``_bucket_seq_shards``) and records it on the
+    plan — ``plan.seq_shards_for(context_len)`` is the admission
+    router's lookup."""
     import time as _time
 
     from ..obs import SearchLog, get_tracer
@@ -417,11 +520,31 @@ def serving_search(pcg: PCG, config, n_dev: int, machine=None,
                     1e-9 + 1e-6 * abs(b.sim_decode_ms), \
                     f"serving selfcheck: {a.describe()} cost drifted"
 
+    # context-length bucketing (ISSUE 18): per searched bucket, pick
+    # seq_shards from the ICI closed forms under the WINNER's kv_dtype
+    # and slot count — the bucket axis rides on top of the chosen mesh
+    from .kvcache import parse_context_buckets
+
+    buckets = parse_context_buckets(
+        context_buckets if context_buckets is not None
+        else getattr(config, "context_buckets", "") or "")
+    shards_by_bucket: Dict[int, int] = {}
+    for bucket in buckets:
+        bs, t_kv, t_comb, fits = _bucket_seq_shards(
+            pcg, machine, n_dev, slots, bucket, winner.kv_dtype, kv_fill)
+        shards_by_bucket[bucket] = bs
+        slog.log(event="bucket", context_bucket=bucket, seq_shards=bs,
+                 kv_stream_ms=round(t_kv * 1e3, 4),
+                 combine_ms=round(t_comb * 1e3, 4),
+                 kv_fits_one_chip=bool(fits),
+                 cost_ms=round((t_kv + t_comb) * 1e3, 4), accepted=True)
+
     wall = _time.perf_counter() - t0
     plan = ServingPlan(
         mesh_shape=winner.mesh_shape, layout=winner.layout, slots=slots,
         max_decode_len=max_len, slo_p99_ms=slo,
         kv_dtype=winner.kv_dtype, prefill_reuse=reuse,
+        context_buckets=buckets, seq_shards_by_bucket=shards_by_bucket,
         sim_decode_ms=winner.sim_decode_ms,
         sim_prefill_ms=winner.sim_prefill_ms,
         sim_p50_ms=winner.sim_p50_ms, sim_p99_ms=winner.sim_p99_ms,

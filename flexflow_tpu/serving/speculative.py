@@ -64,6 +64,21 @@ class SpeculativeDecoder:
         for which, m in (("target", target), ("drafter", drafter)):
             if m.executor is None:
                 raise ValueError(f"{which} model: call compile() first")
+        # ISSUE 18 guard rail: greedy speculative verification scores
+        # draft windows through the single-shard exact path; a sequence-
+        # sharded target (or drafter) would verify against a different
+        # score decomposition than it decodes with. Refuse loudly at
+        # construction instead of accepting garbage token streams.
+        from .kvcache import SeqShardsError
+
+        for which, m in (("target", target), ("drafter", drafter)):
+            if int(getattr(m.config, "seq_shards", 1) or 1) > 1:
+                raise SeqShardsError(
+                    f"speculative decoding does not support --seq-shards "
+                    f"> 1 (the {which} model requests "
+                    f"{int(m.config.seq_shards)} sequence shards); run "
+                    "the sharded engine without a drafter, or set "
+                    "--seq-shards 1")
         t_vocab = self._vocab(target)
         d_vocab = self._vocab(drafter)
         if t_vocab != d_vocab:

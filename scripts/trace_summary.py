@@ -227,6 +227,14 @@ def summarize_telemetry(data, top: int) -> None:
             line += (f"   p50/p99: {srv.get('p50_token_ms')}/"
                      f"{srv['p99_token_ms']} ms")
         print(line)
+        # sequence-parallel decode (ISSUE 18): the per-shard-chip KV
+        # residency at measured fill — the recorded side of the "KV
+        # exceeds one chip" criterion
+        if srv.get("kv_hbm_per_chip_bytes") is not None:
+            b = srv["kv_hbm_per_chip_bytes"]
+            size = (f"{b / 2 ** 20:.1f} MiB" if b >= 2 ** 20
+                    else f"{b / 2 ** 10:.1f} KiB")
+            print(f"  kv per shard chip: {size} at measured fill")
 
     _block(data, "serving", _srv)
 
