@@ -290,6 +290,12 @@ def main():
     # clearly-labeled smoke trajectory like the PR 10 legs
     with tracer.span("fleet_leg"):
         result.update(fleet_leg(on_tpu))
+    # both tiers (ISSUE 19): mixed-SLO isolation (interactive p99 with
+    # and without a batch flood at the WFQ door) and autoscale recovery
+    # after a scripted 4x traffic step vs the fixed fleet — CPU emits a
+    # clearly-labeled smoke trajectory like the fleet leg above
+    with tracer.span("multitenant_leg"):
+        result.update(multitenant_leg(on_tpu))
     # both tiers (ISSUE 15): the hierarchical multi-pod search on the
     # simulated 256/1024/4096-chip topologies — cost model only, so the
     # leg is identical on CPU and TPU (multipod_simulated: true always;
@@ -1018,6 +1024,137 @@ def fleet_leg(on_tpu) -> dict:
             out["fleet_simulated"] = True
     except Exception as e:
         out["fleet_leg_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def multitenant_leg(on_tpu) -> dict:
+    """Multi-tenant SLO leg (ISSUE 19, docs/multitenant.md): (a) the
+    isolation ratio — interactive-tier TTFT p99 through the weighted
+    fair queue with a batch-tier flood riding along, over the same
+    interactive trace served solo (1.0 = perfect isolation; a FIFO door
+    would blow this up with the flood ahead in line); (b) autoscale
+    recovery — fleet ticks until the door queue returns to its
+    pre-surge depth after a scripted 4x traffic step, with the
+    backlog-forecast autoscaler on vs the fixed fleet. CPU numbers are
+    a smoke trajectory (``multitenant_simulated: true``); the TPU tier
+    records the real walls."""
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+    from flexflow_tpu.resilience import FleetChaosPlan
+    from flexflow_tpu.serving import (Request, ServingFleet,
+                                      ServingRejection)
+
+    out = {}
+    try:
+        if on_tpu:
+            cfg = GPT2Config(batch_size=8, seq_len=256, hidden=768,
+                             num_heads=12, num_layers=12,
+                             intermediate=3072, vocab_size=50257)
+            n_int, n_flood, max_new, slots = 12, 24, 16, 4
+        else:
+            cfg = GPT2Config.tiny(batch_size=8)
+            n_int, n_flood, max_new, slots = 6, 12, 6, 2
+        p_lo, p_hi = (4, 12) if on_tpu else (3, 7)
+        config = FFConfig()
+        config.batch_size = cfg.batch_size
+        config.max_decode_len = cfg.seq_len
+        ff = FFModel(config)
+        build_gpt2(ff, cfg)
+        ff.compile(optimizer=AdamOptimizer(ff, alpha=1e-4),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        rng = np.random.default_rng(0)
+
+        def _prompts(n):
+            return [rng.integers(
+                0, cfg.vocab_size,
+                size=int(rng.integers(p_lo, p_hi))).tolist()
+                for _ in range(n)]
+
+        def _run(int_prompts, flood_prompts):
+            """One fleet pass: interactive + batch requests interleaved
+            at the door; returns interactive TTFT samples (ms)."""
+            fleet = ServingFleet(ff, n_replicas=2, n_slots=slots,
+                                 max_decode_len=cfg.seq_len)
+            reqs = []
+            tagged = [(p, "interactive") for p in int_prompts] + \
+                     [(p, "batch") for p in flood_prompts]
+            for i, (p, tenant) in enumerate(tagged):
+                r = Request(prompt=np.asarray(p, dtype=np.int32),
+                            max_new_tokens=max_new, rng_tag=i,
+                            tenant=tenant)
+                try:
+                    fleet.submit(r)
+                except ServingRejection:
+                    pass
+                reqs.append(r)
+            fleet.run()
+            ttft = [r.first_token_ms - r.submit_ms for r in reqs
+                    if r.tenant == "interactive" and r.first_token_ms
+                    and r.submit_ms]
+            return ttft, fleet
+
+        int_prompts = _prompts(n_int)
+        # warm the guarded decode programs so the solo pass doesn't pay
+        # the compiles the flood pass would then skip
+        _run(int_prompts[:2], [])
+        ttft_solo, _ = _run(int_prompts, [])
+        ttft_flood, fleet_f = _run(int_prompts, _prompts(n_flood))
+        if ttft_solo and ttft_flood:
+            p99_solo = float(np.percentile(ttft_solo, 99))
+            p99_flood = float(np.percentile(ttft_flood, 99))
+            out["mt_interactive_solo_p99_ttft_ms"] = round(p99_solo, 3)
+            out["mt_interactive_flood_p99_ttft_ms"] = round(p99_flood, 3)
+            if p99_solo > 0:
+                out["mt_isolation_ratio"] = round(p99_flood / p99_solo, 3)
+        out["mt_flood_tenants"] = {
+            t: row["requests"]
+            for t, row in fleet_f.stats.summary().get(
+                "tenants", {}).items()}
+        # autoscale recovery: a scripted 4x traffic step mid-run, fixed
+        # fleet vs autoscaler (bounds [2, 4]); recovery = ticks until
+        # the door queue drains back to its pre-step depth
+        step_tick, per_tick, n_ticks = 4, 6, 3
+        storm = dict(traffic_step_at={step_tick: (per_tick, n_ticks)},
+                     storm_tenant="batch",
+                     fleet_storm_max_new=max_new,
+                     fleet_storm_prompt_tokens=p_lo)
+
+        def _surge(autoscale):
+            config.autoscale = "on" if autoscale else "off"
+            config.min_replicas = 2 if autoscale else 0
+            config.max_replicas = 4 if autoscale else 0
+            try:
+                # max_queue=16 puts the no-deadline pressure threshold
+                # (max_queue // 2) within the storm's reach
+                fleet = ServingFleet(ff, n_replicas=2, n_slots=slots,
+                                     max_decode_len=cfg.seq_len,
+                                     max_queue=16)
+                fleet.generate(_prompts(n_int),
+                               max_new_tokens=max_new,
+                               chaos=FleetChaosPlan(**storm))
+                return fleet.stats
+            finally:
+                config.autoscale = "off"
+                config.min_replicas = 0
+                config.max_replicas = 0
+
+        st_fix = _surge(False)
+        st_auto = _surge(True)
+        rec_fix = st_fix.surge_recovery_ticks(step_tick)
+        rec_auto = st_auto.surge_recovery_ticks(step_tick)
+        if rec_fix is not None:
+            out["mt_surge_recovery_ticks_fixed"] = rec_fix
+        if rec_auto is not None:
+            out["mt_surge_recovery_ticks_autoscale"] = rec_auto
+        out["mt_autoscale_ups"] = st_auto.autoscale_ups
+        out["mt_autoscale_downs"] = st_auto.autoscale_downs
+        out["mt_storm_requests"] = st_auto.storm_requests
+        if not on_tpu:
+            out["multitenant_simulated"] = True
+    except Exception as e:
+        out["multitenant_leg_error"] = f"{type(e).__name__}: {e}"[:160]
     return out
 
 

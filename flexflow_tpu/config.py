@@ -322,6 +322,21 @@ class FFConfig:
     # quarantines, dispatch timeouts, failed probes) before the
     # replica's circuit opens and it stops receiving dispatches
     circuit_open_after: int = 3
+    # multi-tenant SLO tiers (flexflow_tpu/serving/tenancy.py,
+    # docs/multitenant.md; ISSUE 19): override/extend the built-in
+    # interactive|standard|batch registry with comma-separated
+    # NAME:WEIGHT[:DEADLINE_MS[:QUOTA_TOKENS_PER_S]] entries; empty =
+    # the built-in tiers
+    tenant_tiers: str = ""
+    # backlog-forecast autoscaler on the serving fleet: "on" grows the
+    # replica pool when the backlog-EWMA forecast blows the tightest
+    # tier SLO and shrinks through migrate-and-drain; "off" (default)
+    # keeps the pool fixed
+    autoscale: str = "off"
+    # autoscaler pool bounds (only meaningful with --autoscale on):
+    # 0 = default to the initial fleet size / twice it
+    min_replicas: int = 0
+    max_replicas: int = 0
 
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
@@ -585,6 +600,22 @@ class FFConfig:
                 self.health_probe_every = int(_next())
             elif a == "--circuit-open-after":
                 self.circuit_open_after = int(_next())
+            elif a == "--tenant-tiers":
+                from .serving.tenancy import parse_tenant_tiers
+
+                v = _next()
+                parse_tenant_tiers(v)  # fail fast at parse time
+                self.tenant_tiers = v
+            elif a == "--autoscale":
+                v = _next()
+                if v not in ("on", "off"):
+                    raise ValueError(
+                        f"--autoscale expects on|off, got {v!r}")
+                self.autoscale = v
+            elif a == "--min-replicas":
+                self.min_replicas = int(_next())
+            elif a == "--max-replicas":
+                self.max_replicas = int(_next())
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -757,6 +788,26 @@ class FFConfig:
                 f"--circuit-open-after must be >= 1 (got "
                 f"{self.circuit_open_after}): the circuit opens after "
                 "this many consecutive per-replica failures")
+        if "--min-replicas" in seen and self.min_replicas < 1:
+            raise ValueError(
+                f"--min-replicas must be >= 1 (got "
+                f"{self.min_replicas}): the autoscaler never shrinks "
+                "below this pool size")
+        if "--max-replicas" in seen and self.max_replicas < 1:
+            raise ValueError(
+                f"--max-replicas must be >= 1 (got "
+                f"{self.max_replicas}): the autoscaler never grows "
+                "past this pool size")
+        if ("--min-replicas" in seen or "--max-replicas" in seen) \
+                and self.autoscale != "on":
+            raise ValueError(
+                "--min-replicas/--max-replicas bound the autoscaler's "
+                "pool and are only meaningful with --autoscale on")
+        if "--min-replicas" in seen and "--max-replicas" in seen \
+                and self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"--max-replicas ({self.max_replicas}) must be >= "
+                f"--min-replicas ({self.min_replicas})")
         if "--virtual-stages" in seen:
             if self.pipeline_virtual_stages < 2:
                 raise ValueError(

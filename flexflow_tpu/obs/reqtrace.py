@@ -212,6 +212,7 @@ class RequestTrace:
         replicas: List[Any] = []
         shed: Optional[Dict[str, Any]] = None
         seen_admit = False
+        tenant = None
 
         def close(ts: float) -> None:
             nonlocal t_state
@@ -232,6 +233,8 @@ class RequestTrace:
                     prompt_len = fields.get("prompt_len")
                     max_new = fields.get("max_new")
                     deadline = fields.get("deadline_ms")
+                if tenant is None:
+                    tenant = fields.get("tenant")
                 state = "stall" if seen_admit else "queue"
             elif kind == "admit":
                 close(ts)
@@ -277,6 +280,9 @@ class RequestTrace:
             "prompt_len": prompt_len,
             "max_new_tokens": max_new,
             "deadline_ms": deadline,
+            # ISSUE 19: additive field, RECORD_VERSION unchanged — old
+            # readers ignore it, trace_summary degrades when absent
+            "tenant": tenant,
             "new_tokens": finish_fields.get("new_tokens", ticks),
             "outcome": outcome,
             "finish_reason": reason,
@@ -396,12 +402,17 @@ class FleetTimeSeries:
         self.backlog_ewma_ms: deque = deque(maxlen=self.maxlen)
         self.occupancy: deque = deque(maxlen=self.maxlen)
         self.health: deque = deque(maxlen=self.maxlen)
+        # per-tenant door depth rows (ISSUE 19): {tenant: queued} per
+        # tick, {} when the traffic carries no tenant labels
+        self.tenant_queue: deque = deque(maxlen=self.maxlen)
         self._ewma: Optional[float] = None
 
     def sample(self, tick: int, queue_depth: int, tokens: int,
-               backlog_ms: float, occupancy, health) -> None:
+               backlog_ms: float, occupancy, health,
+               tenants: Optional[Dict[str, int]] = None) -> None:
         """Append one tick: ``occupancy`` is a per-replica sequence of
-        live-slot fractions, ``health`` the matching health strings."""
+        live-slot fractions, ``health`` the matching health strings,
+        ``tenants`` the door depth per explicit tenant."""
         b = float(backlog_ms)
         self._ewma = b if self._ewma is None else \
             self.EWMA_ALPHA * b + (1 - self.EWMA_ALPHA) * self._ewma
@@ -412,6 +423,7 @@ class FleetTimeSeries:
         self.occupancy.append(tuple(round(float(o), 4)
                                     for o in occupancy))
         self.health.append(tuple(health))
+        self.tenant_queue.append(dict(tenants or {}))
 
     def __len__(self) -> int:
         return len(self.ticks)
@@ -433,6 +445,20 @@ class FleetTimeSeries:
                 1 for tick in self.health
                 if any(h != "healthy" for h in tick)),
         }
+
+    def tenant_summary(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant door-depth digest over retained ticks: max and
+        last queued per tenant ({} on pre-tenant series)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for row in self.tenant_queue:
+            for t, n in row.items():
+                d = out.setdefault(t, {"queued_max": 0, "queued_last": 0})
+                d["queued_max"] = max(d["queued_max"], int(n))
+        if self.tenant_queue:
+            last = self.tenant_queue[-1]
+            for t, d in out.items():
+                d["queued_last"] = int(last.get(t, 0))
+        return out
 
 
 # ------------------------------------------------------------- the singleton

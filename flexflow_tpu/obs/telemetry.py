@@ -180,6 +180,13 @@ class StepTelemetry:
         self.fleet_failovers: int = 0
         self.fleet_health_transitions: int = 0
         self.fleet_host_overhead_fraction: Optional[float] = None
+        # multi-tenant + autoscale (ISSUE 19): per-tenant rows
+        # {tenant: {requests, tokens, outcomes}} and the autoscaler's
+        # action counts — filled by ServingFleet._merge_telemetry
+        self.fleet_tenants: Dict[str, Any] = {}
+        self.fleet_quota_sheds: int = 0
+        self.fleet_autoscale_ups: int = 0
+        self.fleet_autoscale_downs: int = 0
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -343,6 +350,14 @@ class StepTelemetry:
             if self.fleet_host_overhead_fraction is not None:
                 fl["host_overhead_fraction"] = round(
                     self.fleet_host_overhead_fraction, 4)
+            if self.fleet_tenants:
+                fl["tenants"] = {t: dict(v) for t, v
+                                 in self.fleet_tenants.items()}
+            if self.fleet_quota_sheds:
+                fl["quota_sheds"] = self.fleet_quota_sheds
+            if self.fleet_autoscale_ups or self.fleet_autoscale_downs:
+                fl["autoscale"] = {"ups": self.fleet_autoscale_ups,
+                                   "downs": self.fleet_autoscale_downs}
             out["fleet"] = fl
         if (self.serving_prefix_hits or self.serving_prefix_tokens_reused
                 or self.serving_prefill_tokens_computed

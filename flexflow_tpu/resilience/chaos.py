@@ -374,6 +374,15 @@ class FleetChaosPlan(ChaosPlan):
       (the rolling zero-downtime restart path).
     * ``rejoin_at={tick: replica}`` — a killed/drained/degraded replica
       re-enters through half-open probation (probe decode gates it).
+    * ``traffic_step_at={tick: (per_tick, ticks)}`` — a sustained
+      traffic step (ISSUE 19): starting at ``tick``, inject ``per_tick``
+      synthetic ``storm_tenant`` requests through the REAL fleet door
+      every tick for ``ticks`` ticks — the scripted 4x surge the
+      autoscaler must absorb.
+    * ``tenant_storm_at={tick: (tenant, n)}`` — a one-shot burst of
+      ``n`` requests from one tenant (once-semantics like every other
+      fleet fault), for proving WFQ isolation under a misbehaving
+      neighbor.
     """
 
     def __init__(self, kill_replica_at: Optional[dict] = None,
@@ -383,6 +392,11 @@ class FleetChaosPlan(ChaosPlan):
                  rejoin_at: Optional[dict] = None,
                  partition_ticks: int = 8,
                  degrade_poison_every: int = 1,
+                 traffic_step_at: Optional[dict] = None,
+                 tenant_storm_at: Optional[dict] = None,
+                 storm_tenant: str = "batch",
+                 fleet_storm_max_new: int = 8,
+                 fleet_storm_prompt_tokens: int = 3,
                  **kw):
         super().__init__(**kw)
         self.kill_replica_at = {int(k): int(v) for k, v in
@@ -397,6 +411,16 @@ class FleetChaosPlan(ChaosPlan):
                           (rejoin_at or {}).items()}
         self.partition_ticks = int(partition_ticks)
         self.degrade_poison_every = max(int(degrade_poison_every), 1)
+        self.traffic_step_at = {
+            int(k): (int(v[0]), int(v[1]))
+            for k, v in (traffic_step_at or {}).items()}
+        self.tenant_storm_at = {
+            int(k): (str(v[0]), int(v[1]))
+            for k, v in (tenant_storm_at or {}).items()}
+        self.storm_tenant = str(storm_tenant)
+        self.fleet_storm_max_new = int(fleet_storm_max_new)
+        self.fleet_storm_prompt_tokens = int(fleet_storm_prompt_tokens)
+        self.storm_requests_injected = 0
         self.replicas_killed: List[int] = []
         self.replicas_degraded: List[int] = []
         self.replicas_partitioned: List[int] = []
@@ -433,6 +457,28 @@ class FleetChaosPlan(ChaosPlan):
     def maybe_rejoin_replica(self, tick: int) -> Optional[int]:
         return self._fire(self.rejoin_at, tick, "rejoin",
                           self.replicas_rejoined)
+
+    def maybe_fleet_storm(self, tick: int) -> List[tuple]:
+        """``[(tenant, n), ...]`` to inject at the fleet door this tick
+        (ISSUE 19). One-shot storms honor the once-semantics key; a
+        traffic step fires on every tick inside its window (each window
+        tick is its own key, so ``once`` replays stay deterministic)."""
+        tick = int(tick)
+        out: List[tuple] = []
+        burst = self.tenant_storm_at.get(tick)
+        if burst is not None and not (self.once and
+                                      ("tenant_storm", tick)
+                                      in self._fleet_done):
+            self._fleet_done.add(("tenant_storm", tick))
+            out.append(burst)
+        for start, (per_tick, n_ticks) in self.traffic_step_at.items():
+            if start <= tick < start + n_ticks and not (
+                    self.once and ("traffic_step", tick)
+                    in self._fleet_done):
+                self._fleet_done.add(("traffic_step", tick))
+                out.append((self.storm_tenant, per_tick))
+        self.storm_requests_injected += sum(n for _t, n in out)
+        return out
 
 
 class _InjectedReductionOp:

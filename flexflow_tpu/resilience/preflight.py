@@ -163,6 +163,31 @@ def preflight_config(config) -> None:
                 "--context-buckets requires --kv-cache paged: buckets "
                 "route requests to sequence-sharded block-table "
                 "partitions")
+    asc = (getattr(config, "autoscale", "off") or "off")
+    if asc not in ("on", "off"):
+        raise PreflightError(
+            f"--autoscale expects on|off, got {asc!r}")
+    mn = int(getattr(config, "min_replicas", 0) or 0)
+    mx = int(getattr(config, "max_replicas", 0) or 0)
+    if mn < 0 or mx < 0:
+        raise PreflightError(
+            f"--min-replicas/--max-replicas must be >= 0 (got {mn}/{mx}); "
+            "0 defaults to the initial fleet size / twice it")
+    if (mn or mx) and asc != "on":
+        raise PreflightError(
+            "--min-replicas/--max-replicas bound the autoscaler's pool "
+            "and are only meaningful with --autoscale on")
+    if mn and mx and mx < mn:
+        raise PreflightError(
+            f"--max-replicas ({mx}) must be >= --min-replicas ({mn})")
+    tiers = getattr(config, "tenant_tiers", "") or ""
+    if tiers:
+        from ..serving.tenancy import parse_tenant_tiers
+
+        try:
+            parse_tenant_tiers(tiers)
+        except ValueError as e:
+            raise PreflightError(str(e))
 
 
 # --------------------------------------------------------------- strategy

@@ -21,6 +21,9 @@ from .resilience import (AdmissionController,  # noqa: F401
                          OUTCOMES, OverloadError, ServingResilience)
 from .search import (ServingCandidate, ServingPlan,  # noqa: F401
                      ServingSearchError, serving_search)
+from .tenancy import (QuotaExceededError, TENANT_TIERS,  # noqa: F401
+                      TenantPolicy, TenantRegistry, WeightedFairQueue,
+                      parse_tenant_tiers)
 from .fleet import (CircuitBreaker, FLEET_HEALTH,  # noqa: F401
                     FLEET_MIN_RETRY_AFTER_MS, FleetReplica, FleetStats,
                     ServingFleet, lint_replica_plans, plan_replicas)

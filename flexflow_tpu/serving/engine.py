@@ -1386,6 +1386,12 @@ class _ServeLoop:
         if chaos is not None:
             res.chaos = chaos
         self.chaos = res.chaos
+        # a caller-built resilience arrives with a cold default
+        # controller; carry the engine's warm per-token EWMA across so
+        # post-replan/rebuild shedding isn't blind for the first window
+        # (no-op when the caller's controller is already warm)
+        if res.controller is not eng.admission:
+            res.controller.warm_start(eng.admission)
         sched.shed_policy = res.shed_policy
         eng._attach_kv_accounting(sched)
         # ONE time base: submit stamps were taken with the scheduler's
@@ -1779,7 +1785,9 @@ class _ServeLoop:
         self.step_no += 1
         stats.kv_bytes_read += eng._decode_kv_bytes(live)
         if self.res_active:
-            res.controller.observe_step(wall, len(live))
+            res.controller.observe_step(
+                wall, len(live),
+                tenants=[r.tenant for _s, r in live if r.tenant])
         for i, (slot, req) in enumerate(live):
             if epochs is not None and (
                     sched.slots[slot] is not req

@@ -69,8 +69,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from collections import deque
-from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -79,6 +78,8 @@ from .engine import ServingEngine, _ServeLoop
 from .resilience import AdmissionController, OverloadError
 from .scheduler import (ContinuousBatchScheduler, QueueFullError, Request,
                         ServingRejection, now_ms, remove_by_identity)
+from .tenancy import (QuotaExceededError, TenantRegistry,
+                      WeightedFairQueue)
 
 #: health states a replica moves through (docs/fleet.md has the diagram)
 FLEET_HEALTH = ("healthy", "degraded", "quarantined", "draining", "dead")
@@ -276,6 +277,52 @@ class FleetStats:
     # blocking host transfers across all replica loops (ISSUE 17): the
     # fleet analog of ServingStats.host_syncs
     host_syncs: int = 0
+    # multi-tenant accounting (ISSUE 19): per-tenant ledgers over
+    # requests that carried an explicit tenant label — tenant_outcomes
+    # conserves exactly-one-outcome per tenant (tier-1 pins it);
+    # quota_sheds counts door rejections under the token-rate quota
+    tenant_requests: Dict[str, int] = dataclasses.field(default_factory=dict)
+    tenant_outcomes: Dict[str, Dict[str, int]] = \
+        dataclasses.field(default_factory=dict)
+    tenant_tokens: Dict[str, int] = dataclasses.field(default_factory=dict)
+    quota_sheds: int = 0
+    # requests injected by the FleetChaosPlan traffic-step/tenant-storm
+    # generator (they ARE externally-visible requests and ride the same
+    # ledgers; this just says how many came from chaos)
+    storm_requests: int = 0
+    # autoscaler (ISSUE 19): (tick, "up"|"down", serving replicas after)
+    autoscale_ups: int = 0
+    autoscale_downs: int = 0
+    autoscale_events: List[Tuple[int, str, int]] = \
+        dataclasses.field(default_factory=list)
+    # waiting requests per fleet tick (door + replica scheduler queues:
+    # dispatch drains the door eagerly, so the door alone sees nothing)
+    # — the surge-recovery series
+    queue_depth_history: List[int] = dataclasses.field(default_factory=list)
+
+    def count_tenant_outcome(self, tenant: Optional[str],
+                             outcome: str) -> None:
+        if not tenant:
+            return  # untenanted traffic stays aggregate-only
+        led = self.tenant_outcomes.setdefault(tenant, {})
+        led[outcome] = led.get(outcome, 0) + 1
+
+    def surge_recovery_ticks(self, step_tick: int,
+                             baseline: Optional[int] = None
+                             ) -> Optional[int]:
+        """Ticks after ``step_tick`` until the waiting-request depth
+        first returns to its pre-step level (or ``baseline``) — the
+        traffic-surge analog of :meth:`recovery_ticks`. None when it
+        never drained."""
+        hist = self.queue_depth_history
+        if step_tick >= len(hist):
+            return None
+        if baseline is None:
+            baseline = hist[step_tick - 1] if step_tick > 0 else 0
+        for t in range(step_tick + 1, len(hist)):
+            if hist[t] <= baseline:
+                return t - step_tick
+        return None
 
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         if n:
@@ -338,10 +385,20 @@ class FleetStats:
                   "hedge_twin_wins", "hedges_cancelled", "affinity_hits",
                   "affinity_tokens", "probes",
                   "probe_failures", "circuit_opens", "drains", "rejoins",
-                  "degrade_poisons"):
+                  "degrade_poisons", "quota_sheds", "storm_requests"):
             v = getattr(self, k)
             if v:
                 out[k] = v
+        if self.tenant_outcomes:
+            out["tenants"] = {
+                t: {"requests": self.tenant_requests.get(t, 0),
+                    "tokens": self.tenant_tokens.get(t, 0),
+                    "outcomes": dict(led)}
+                for t, led in sorted(self.tenant_outcomes.items())}
+        if self.autoscale_ups or self.autoscale_downs:
+            out["autoscale"] = {"ups": self.autoscale_ups,
+                                "downs": self.autoscale_downs,
+                                "events": list(self.autoscale_events)}
         if self.health_transitions:
             out["health_transitions"] = len(self.health_transitions)
         return out
@@ -465,7 +522,32 @@ class ServingFleet:
         # and a hedge only targets an IDLE replica (free slot, empty
         # queue) — a hedge must never displace first-try traffic
         self.hedge_cap = max(1, n - 1)
-        self.queue: Deque[Request] = deque()
+        # multi-tenant door (ISSUE 19, docs/multitenant.md): the tier
+        # registry (policies + quota buckets) and the weighted fair
+        # queue replacing the single FIFO — untenanted traffic rides
+        # the standard tier and degenerates to exact FIFO
+        self.tenants = TenantRegistry.from_config(config)
+        self.queue: WeightedFairQueue = WeightedFairQueue(self.tenants)
+        # backlog-forecast autoscaler (docs/multitenant.md state
+        # machine): off unless --autoscale on; bounds default to
+        # [initial N, 2N]; hysteresis = the up/down factor gap plus the
+        # consecutive-tick patience plus a post-action cooldown
+        self.autoscale = (getattr(config, "autoscale", "off")
+                          or "off") == "on"
+        self.min_replicas = int(getattr(config, "min_replicas", 0)
+                                or 0) or n
+        self.max_replicas = max(
+            int(getattr(config, "max_replicas", 0) or 0) or 2 * n,
+            self.min_replicas)
+        self.autoscale_up_after = 2      # consecutive over-SLO ticks
+        self.autoscale_down_after = 8    # consecutive slack ticks
+        self.autoscale_cooldown = 4      # ticks after any action
+        self.autoscale_down_factor = 0.3
+        self._forecast_ewma: Optional[float] = None
+        self._surge_ticks = 0
+        self._slack_ticks = 0
+        self._cooldown_until = 0
+        self._storm_seq = 0
         self.drained_requests: List[Request] = []
         self.clock = clock if clock is not None else now_ms
         self.chaos = None
@@ -517,16 +599,29 @@ class ServingFleet:
                 if r.alive and r.health != "draining"
                 and r.circuit.state == "closed"]
 
-    def retry_after_ms(self) -> float:
+    def retry_after_ms(self, tenant: Optional[str] = None) -> float:
         """The fleet door's backoff hint: the MINIMUM over healthy
         replicas' drain estimates (the best replica frees up first — a
         fleet sick on one replica must not shed like a fleet sick
         everywhere), floored at :data:`FLEET_MIN_RETRY_AFTER_MS`
         whenever any replica is draining, circuit-open or dead (ISSUE 11
         small fix: the 0 hint of a cold EWMA would invite an immediate
-        retry storm into a degraded fleet)."""
+        retry storm into a degraded fleet).
+
+        With ``tenant`` the hint additionally prices that tenant's OWN
+        virtual queue position under WFQ (ISSUE 19 satellite): the door
+        tokens scheduled ahead of a new request of this tenant, at the
+        tenant's per-token cost. Without it a rejected batch client
+        would be handed the interactive tenant's optimistic hint and
+        resubmit straight into another rejection."""
         healthy = self._healthy()
         est = min((r.drain_estimate_ms() for r in healthy), default=0.0)
+        if tenant is not None and healthy:
+            ahead = self.queue.backlog_tokens_ahead(tenant)
+            cost = max((r.engine.admission.token_cost_ms_for(tenant)
+                        for r in healthy), default=0.0)
+            capacity = sum(r.engine.n_slots for r in healthy)
+            est += cost * ahead / max(capacity, 1)
         degraded = any(
             (not r.alive) or r.health == "draining"
             or r.circuit.state != "closed" for r in self.replicas)
@@ -548,6 +643,15 @@ class ServingFleet:
         ledgered (outcome ``shed``): exactly-one-outcome holds at the
         fleet door too."""
         self._requests.append(req)
+        pol = self.tenants.policy(req.tenant)
+        if req.tenant:
+            self.stats.tenant_requests[req.tenant] = \
+                self.stats.tenant_requests.get(req.tenant, 0) + 1
+        # tier deadline default (ISSUE 19): most specific wins — an
+        # explicit per-request deadline, then the tenant tier's default,
+        # then --request-timeout-ms via _stamp_deadline
+        if req.deadline_ms is None and pol.deadline_ms > 0:
+            req.deadline_ms = float(pol.deadline_ms)
         self._stamp_deadline(req)
         # the relative deadline budget starts at the FLEET DOOR: waiting
         # here burns it exactly like waiting in a replica queue (the
@@ -561,65 +665,109 @@ class ServingFleet:
             rt.note(req.rid, "submit", req.submit_ms,
                     prompt_len=req.prompt_len,
                     max_new=req.max_new_tokens,
-                    deadline_ms=req.deadline_ms, replica=None)
+                    deadline_ms=req.deadline_ms, replica=None,
+                    tenant=req.tenant)
+        # token-rate quota (docs/multitenant.md): charged on the
+        # REQUESTED tokens before any shed gate — a quota breach is the
+        # tenant's own doing and must not consume shed headroom
+        if pol.quota_tokens_per_s > 0:
+            ok, wait_ms = self.tenants.charge(
+                req.tenant, req.max_new_tokens, float(self.clock()))
+            if not ok:
+                self.stats.quota_sheds += 1
+                req.outcome = "quota_exceeded"
+                self.stats.count_tenant_outcome(req.tenant,
+                                                "quota_exceeded")
+                if rt.enabled:
+                    rt.finish(req.rid, float(self.clock()),
+                              "quota_exceeded", policy="quota",
+                              tenant=req.tenant,
+                              refill_ms=round(wait_ms, 3))
+                raise QuotaExceededError(
+                    f"request {req.rid} rejected: tenant "
+                    f"{pol.name!r} token-rate quota "
+                    f"({pol.quota_tokens_per_s:g} tokens/s) exhausted",
+                    queued=self._total_queued(), active=0,
+                    retry_after_ms=max(
+                        wait_ms, self.retry_after_ms(req.tenant)))
         healthy = self._healthy()
         policy = self.shed_policy
         total_queued = self._total_queued()
         if policy == "queue":
-            highwater = max(self.max_queue // 2, 1)
+            highwater = self._shed_highwater(pol)
             if total_queued >= highwater:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                self.stats.count_tenant_outcome(req.tenant, "shed")
                 if rt.enabled:
                     rt.finish(req.rid, float(self.clock()), "shed",
                               policy="queue", queued=total_queued,
-                              highwater=highwater)
+                              highwater=highwater, tenant=req.tenant)
                 raise OverloadError(
                     f"request {req.rid} shed at the fleet door (policy "
                     f"'queue'): aggregate queue depth {total_queued} >= "
-                    f"high-water {highwater} (fleet max_queue "
-                    f"{self.max_queue})",
+                    f"high-water {highwater} for tier "
+                    f"{pol.name!r} (fleet max_queue {self.max_queue})",
                     queued=total_queued,
                     active=sum(r.sched.active for r in self.replicas
                                if r.sched is not None),
-                    retry_after_ms=self.retry_after_ms())
+                    retry_after_ms=self.retry_after_ms(req.tenant))
         elif policy == "deadline" and req.deadline_ms is not None \
                 and req.deadline_ms > 0 and healthy:
             backlog = sum(r.outstanding_tokens() for r in healthy)
             capacity = sum(r.engine.n_slots for r in healthy)
-            cost = min((r.engine.admission.token_cost_ms for r in healthy
-                        if r.engine.admission.token_cost_ms > 0),
-                       default=0.0)
+            cost = min(
+                (r.engine.admission.token_cost_ms_for(req.tenant)
+                 for r in healthy
+                 if r.engine.admission.token_cost_ms_for(req.tenant) > 0),
+                default=0.0)
             est = cost * (backlog / max(capacity, 1) + req.max_new_tokens)
             if est > req.deadline_ms:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                self.stats.count_tenant_outcome(req.tenant, "shed")
                 if rt.enabled:
                     # the PRICED estimate that made the decision rides
                     # on the terminal record — sheds are explainable
                     rt.finish(req.rid, float(self.clock()), "shed",
                               policy="deadline", est_ms=round(est, 3),
-                              deadline_ms=req.deadline_ms)
+                              deadline_ms=req.deadline_ms,
+                              tenant=req.tenant)
                 raise OverloadError(
                     f"request {req.rid} shed at the fleet door (policy "
                     f"'deadline'): estimated completion {est:.1f} ms "
                     f"across {len(healthy)} healthy replica(s) exceeds "
                     f"deadline {req.deadline_ms:.1f} ms",
                     queued=total_queued, active=0,
-                    retry_after_ms=self.retry_after_ms())
+                    retry_after_ms=self.retry_after_ms(req.tenant))
         if total_queued >= self.max_queue:
             self.stats.sheds += 1
             req.outcome = "shed"
+            self.stats.count_tenant_outcome(req.tenant, "shed")
             if rt.enabled:
                 rt.finish(req.rid, float(self.clock()), "shed",
-                          policy="hard_wall", queued=total_queued)
+                          policy="hard_wall", queued=total_queued,
+                          tenant=req.tenant)
             raise QueueFullError(
                 f"fleet queue full ({total_queued} waiting across "
                 f"{self.n_replicas} replicas, shed policy "
                 f"'{policy}'); retry later",
                 queued=total_queued, active=0,
-                retry_after_ms=self.retry_after_ms())
+                retry_after_ms=self.retry_after_ms(req.tenant))
         self.queue.append(req)
+
+    def _shed_highwater(self, pol) -> int:
+        """Per-tier queue-shed threshold (docs/multitenant.md): the
+        standard tier keeps the pre-tenant ``max_queue // 2`` high-water
+        exactly; lower shed priority halves it (batch backs off first,
+        preserving headroom for the tiers above), higher priority sheds
+        only at the hard wall."""
+        base = max(self.max_queue // 2, 1)
+        if pol.shed_priority <= 0:
+            return max(base // 2, 1)
+        if pol.shed_priority == 1:
+            return base
+        return self.max_queue
 
     # -------------------------------------------------------------- lifecycle
     def _make_loop(self, rep: FleetReplica) -> None:
@@ -887,6 +1035,175 @@ class ServingFleet:
                     and tick > 0 and tick % self.health_probe_every == 0:
                 self._probe(rep)
 
+    # ------------------------------------------------------------- autoscale
+    def _slo_target_ms(self) -> float:
+        """The SLO the forecast is judged against: the TIGHTEST deadline
+        present in current traffic (door + in-flight), falling back to
+        --request-timeout-ms. The tier with the least headroom sets the
+        bar — scaling for the batch tier's deadline while interactive
+        burns would invert the feature."""
+        deadlines = [float(r.deadline_ms) for r in self.queue
+                     if r.deadline_ms and r.deadline_ms > 0]
+        for rep in self.replicas:
+            if rep.alive and rep.sched is not None:
+                deadlines.extend(
+                    float(r.deadline_ms)
+                    for r in list(rep.sched.queue)
+                    + [s for s in rep.sched.slots if s is not None]
+                    if r.deadline_ms and r.deadline_ms > 0)
+        if deadlines:
+            return min(deadlines)
+        return float(getattr(self.config, "request_timeout_ms", 0.0)
+                     or 0.0)
+
+    def _serving_replicas(self) -> List[FleetReplica]:
+        return [r for r in self.replicas
+                if r.alive and r.health != "draining"]
+
+    def _waiting_requests(self) -> int:
+        """Requests admitted but not yet in a decode slot, fleet-wide:
+        the door PLUS the replica scheduler queues (dispatch drains the
+        door eagerly, so the door alone under-counts a surge)."""
+        return len(self.queue) + sum(
+            r.sched.queued for r in self.replicas
+            if r.alive and r.sched is not None)
+
+    def _autoscale_tick(self) -> None:
+        """Backlog-forecast autoscaler (docs/multitenant.md has the state
+        machine): forecast = EWMA of (per-token cost x total outstanding
+        tokens / serving slots) — the time the current backlog needs to
+        drain. Over-SLO for ``autoscale_up_after`` consecutive ticks
+        grows the pool (through half-open probation, like rejoin); under
+        ``autoscale_down_factor`` x SLO for ``autoscale_down_after``
+        ticks shrinks it through the existing migrate-and-drain. A
+        cooldown after each action keeps the controller from flapping on
+        its own transient."""
+        serving = self._serving_replicas()
+        slots = sum(r.engine.n_slots for r in serving)
+        cost = max((r.engine.admission.token_cost_ms for r in serving),
+                   default=0.0)
+        door = sum(r.max_new_tokens - len(r.generated)
+                   for r in self.queue)
+        backlog = door + sum(r.outstanding_tokens() for r in serving)
+        forecast = cost * backlog / max(slots, 1)
+        if self._forecast_ewma is None:
+            self._forecast_ewma = forecast
+        else:
+            self._forecast_ewma += 0.2 * (forecast - self._forecast_ewma)
+        slo = self._slo_target_ms()
+        if slo > 0:
+            over = self._forecast_ewma > slo
+            under = self._forecast_ewma < self.autoscale_down_factor * slo \
+                and len(self.queue) == 0
+        else:
+            # no deadline anywhere: fall back to waiting-request
+            # pressure — more than two full refills queued per slot is
+            # a surge, an empty wait line with the in-flight work
+            # fitting the slots is slack
+            waiting = self._waiting_requests()
+            over = waiting >= 2 * max(slots, 1)
+            under = waiting == 0 and backlog <= slots
+        if over:
+            self._surge_ticks += 1
+            self._slack_ticks = 0
+        elif under:
+            self._slack_ticks += 1
+            self._surge_ticks = 0
+        else:
+            self._surge_ticks = 0
+            self._slack_ticks = 0
+        if self.tick_no < self._cooldown_until:
+            return
+        if self._surge_ticks >= self.autoscale_up_after \
+                and len(serving) < self.max_replicas:
+            self._scale_up()
+            self._surge_ticks = 0
+            self._cooldown_until = self.tick_no + self.autoscale_cooldown
+        elif self._slack_ticks >= self.autoscale_down_after \
+                and len(serving) > self.min_replicas:
+            self._scale_down()
+            self._slack_ticks = 0
+            self._cooldown_until = self.tick_no + self.autoscale_cooldown
+
+    def _autoscale_plan(self):
+        """A searched plan for the new replica's mesh, warm-started from
+        the per-(generation, dtype) calibration store via
+        :func:`plan_replicas` — None when the seed fleet itself runs
+        planless (the tier-1 CPU shape) or the search cannot run here."""
+        if all(r.plan is None for r in self.replicas):
+            return None
+        try:
+            import jax
+            n_dev = max(1, len(jax.devices()))
+            return plan_replicas(self.model.executor.pcg, self.config,
+                                 [n_dev])[0]
+        except Exception:  # noqa: BLE001 — planless beats no scale-up
+            return None
+
+    def _scale_up(self) -> None:
+        """Grow the pool by one replica cloned from replica 0's shape.
+        The newcomer enters service through the SAME half-open probation
+        as a rejoin — its first dispatch waits for a passing probe — and
+        its admission controller warm-starts from the warmest sibling
+        (ISSUE 19 satellite: post-scale shedding must not be blind)."""
+        ref = self.replicas[0].engine
+        idx = len(self.replicas)
+        eng = ServingEngine(
+            self.model, n_slots=ref.n_slots,
+            max_decode_len=ref.max_decode_len, buckets=ref.buckets,
+            max_queue=ref.max_queue, eos_id=self.eos_id,
+            exact_decode=ref.exact_decode,
+            serve_loop=getattr(ref, "serve_loop", None))
+        warmest = max((r.engine.admission for r in self.replicas),
+                      key=lambda a: a.observed_steps)
+        eng.admission.warm_start(warmest)
+        plan = self._autoscale_plan()
+        eng.plan = plan or eng.plan
+        rep = FleetReplica(
+            idx, eng, plan=plan,
+            open_after=int(getattr(self.config, "circuit_open_after", 3)
+                           or 3))
+        self.replicas.append(rep)
+        self.n_replicas = len(self.replicas)
+        self.stats.replicas = self.n_replicas
+        self.stats.dispatches.append(0)
+        self.hedge_cap = max(1, self.n_replicas - 1)
+        self._make_loop(rep)
+        rep.circuit.force_open(half_open_at=self.tick_no + 1)
+        self._set_health(rep, "quarantined", "autoscale_probation")
+        self.stats.autoscale_ups += 1
+        self.stats.autoscale_events.append(
+            (self.tick_no, "up", len(self._serving_replicas())))
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("fleet_autoscale", action="up",
+                         tick=self.tick_no, replica=idx,
+                         serving=len(self._serving_replicas()),
+                         forecast_ms=round(self._forecast_ewma or 0.0, 3))
+
+    def _scale_down(self) -> None:
+        """Shrink by one through the existing migrate-and-drain: the
+        chosen replica stops admitting, finishes its in-flight streams,
+        and its queued work re-routes — scale-down NEVER drops a live
+        stream. Deterministic victim: the least-loaded closed-circuit
+        replica, highest index breaking ties (LIFO, so the seed replicas
+        outlive the surge capacity)."""
+        cands = [r for r in self._serving_replicas()
+                 if r.loop is not None and r.circuit.state == "closed"]
+        if len(cands) <= self.min_replicas:
+            return
+        rep = min(cands, key=lambda r: (r.outstanding_tokens(), -r.idx))
+        self.drain(rep.idx)
+        self.stats.autoscale_downs += 1
+        self.stats.autoscale_events.append(
+            (self.tick_no, "down", len(self._serving_replicas())))
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("fleet_autoscale", action="down",
+                         tick=self.tick_no, replica=rep.idx,
+                         serving=len(self._serving_replicas()),
+                         forecast_ms=round(self._forecast_ewma or 0.0, 3))
+
     # -------------------------------------------------------------- failover
     def _harvest(self, rep: FleetReplica) -> Tuple[List[Request],
                                                    List[Request]]:
@@ -1151,6 +1468,37 @@ class ServingFleet:
         r = chaos.maybe_rejoin_replica(tick)
         if r is not None:
             self.rejoin(r)
+        storm = getattr(chaos, "maybe_fleet_storm", None)
+        if storm is not None:
+            for tenant, n in storm(tick):
+                self._inject_storm(tenant, n, chaos)
+
+    def _inject_storm(self, tenant: Optional[str], n: int,
+                      chaos) -> None:
+        """Scripted traffic-step/tenant-storm injection (ISSUE 19): ``n``
+        synthetic requests of ``tenant`` through the REAL door —
+        submit(), quota, shed gates, WFQ and the ledgers all see them as
+        ordinary traffic. Storm rng tags live in their own range
+        (2_000_000+) so they can never collide with caller tags or the
+        engine-level storm's 1_000_000 range."""
+        max_new = int(getattr(chaos, "fleet_storm_max_new", 8) or 8)
+        plen = int(getattr(chaos, "fleet_storm_prompt_tokens", 3) or 3)
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("fleet_tenant_storm", tick=self.tick_no,
+                         tenant=tenant, requests=n)
+        for _ in range(int(n)):
+            seq = self._storm_seq
+            self._storm_seq += 1
+            req = Request(
+                prompt=np.asarray([(seq % 7) + 1] * plen, np.int32),
+                max_new_tokens=max_new, eos_id=self.eos_id,
+                rng_tag=2_000_000 + seq, tenant=tenant)
+            self.stats.storm_requests += 1
+            try:
+                self.submit(req)
+            except ServingRejection:
+                pass  # ledgered at the door; the storm presses on
 
     def _maybe_degrade_tick(self, rep: FleetReplica) -> None:
         """Scripted sustained decode poison (FleetChaosPlan degrade):
@@ -1261,6 +1609,8 @@ class ServingFleet:
                 if chaos is not None:
                     self._apply_chaos(chaos)
                 self._run_probes()
+                if self.autoscale:
+                    self._autoscale_tick()
                 if session.preempted and not self._fleet_draining:
                     # flag-only handler fired: fleet-wide graceful drain
                     # — checked BEFORE dispatch so admission stops in
@@ -1291,6 +1641,8 @@ class ServingFleet:
                 self._mirror_adopted()
                 self._launch_hedges()
                 self.stats.tokens_history.append(self._tick_tokens)
+                self.stats.queue_depth_history.append(
+                    self._waiting_requests())
                 if self.timeseries is not None:
                     self.timeseries.sample(
                         self.tick_no, len(self.queue), self._tick_tokens,
@@ -1299,7 +1651,8 @@ class ServingFleet:
                         [(r.sched.active / max(r.engine.n_slots, 1))
                          if (r.alive and r.sched is not None) else 0.0
                          for r in self.replicas],
-                        [r.health for r in self.replicas])
+                        [r.health for r in self.replicas],
+                        tenants=self.queue.queued_by_tenant())
                 self.tick_no += 1
                 self._host_router_s += time.perf_counter() - t_post
                 if worked:
@@ -1352,10 +1705,19 @@ class ServingFleet:
         # request under exactly one outcome; hedge twins are internal
         # and never counted (their winner's entry lives on the primary)
         st.outcomes = {}
+        # per-tenant ledgers rebuilt from the same sweep (door-time
+        # counts were provisional): one outcome per request per tenant
+        st.tenant_outcomes = {}
+        st.tenant_tokens = {}
         rt = get_reqtrace()
         for req in self._requests:
             outcome = req.outcome or ("ok" if req.done else "preempted")
             st.count_outcome(outcome)
+            st.count_tenant_outcome(req.tenant, outcome)
+            if req.tenant and req.generated:
+                st.tenant_tokens[req.tenant] = \
+                    st.tenant_tokens.get(req.tenant, 0) + \
+                    len(req.generated)
             if rt.enabled:
                 # finalize is idempotent (first terminal note wins):
                 # requests the schedulers already finished drop this; only
@@ -1421,6 +1783,14 @@ class ServingFleet:
         tel.fleet_failovers = st.failovers
         tel.fleet_health_transitions = len(st.health_transitions)
         tel.fleet_host_overhead_fraction = st.host_overhead_fraction()
+        tel.fleet_tenants = {
+            t: {"requests": st.tenant_requests.get(t, 0),
+                "tokens": st.tenant_tokens.get(t, 0),
+                "outcomes": dict(led)}
+            for t, led in sorted(st.tenant_outcomes.items())}
+        tel.fleet_quota_sheds = st.quota_sheds
+        tel.fleet_autoscale_ups = st.autoscale_ups
+        tel.fleet_autoscale_downs = st.autoscale_downs
         tel.finalize()
         if self.model.config.telemetry_file:
             tel.write(self.model.config.telemetry_file)

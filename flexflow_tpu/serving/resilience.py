@@ -60,7 +60,8 @@ from .scheduler import (ContinuousBatchScheduler, Request,
 
 #: terminal request dispositions — every request that enters the system
 #: leaves it under exactly one of these (asserted end-to-end in tier-1)
-OUTCOMES = ("ok", "deadline_exceeded", "shed", "decode_fault", "preempted")
+OUTCOMES = ("ok", "deadline_exceeded", "shed", "quota_exceeded",
+            "decode_fault", "preempted")
 
 SHED_POLICIES = ("off", "deadline", "queue")
 
@@ -162,6 +163,10 @@ class AdmissionController:
         # observe_step — this additionally tracks the acceptance-rate
         # EWMA for introspection/telemetry (None until speculation runs)
         self.spec_acceptance: Optional[float] = None
+        # per-tenant token-cost EWMAs (ISSUE 19): same alpha, fed only
+        # on steps where the tenant held a live slot — a tenant's cost
+        # diverges from the aggregate through WHICH steps it rides
+        self._tenant_ewma_ms = {}
 
     def observe_speculation(self, accepted: int, proposed: int) -> None:
         """Feed one verification round's (accepted, proposed) draft
@@ -184,13 +189,48 @@ class AdmissionController:
             return float(self.force_token_cost_ms)
         return self._ewma_token_ms or 0.0
 
-    def observe_step(self, wall_s: float, tokens: int) -> None:
+    def token_cost_ms_for(self, tenant: Optional[str]) -> float:
+        """Per-tenant cost when that tenant's EWMA has warmed, else the
+        aggregate — untenanted callers get exactly :attr:`token_cost_ms`."""
+        if self.force_token_cost_ms is not None:
+            return float(self.force_token_cost_ms)
+        if tenant is not None:
+            v = self._tenant_ewma_ms.get(tenant)
+            if v is not None:
+                return v
+        return self._ewma_token_ms or 0.0
+
+    def observe_step(self, wall_s: float, tokens: int,
+                     tenants=None) -> None:
         cost = wall_s * 1e3 / max(int(tokens), 1)
         if self._ewma_token_ms is None:
             self._ewma_token_ms = cost
         else:
             self._ewma_token_ms += self.alpha * (cost - self._ewma_token_ms)
         self.observed_steps += 1
+        for t in set(tenants or ()):
+            prev = self._tenant_ewma_ms.get(t)
+            self._tenant_ewma_ms[t] = cost if prev is None else \
+                prev + self.alpha * (cost - prev)
+
+    def warm_start(self, other: "AdmissionController") -> None:
+        """Adopt ``other``'s warm cost model iff this controller is cold.
+
+        Replans, pool rebuilds, and autoscale scale-ups hand traffic to
+        a fresh controller; without the carry the first post-recovery
+        shedding window prices everything at cost 0 (admit-everything)
+        until the EWMA re-warms. Never copies ``force_token_cost_ms`` —
+        a test pin stays local to the controller it was set on.
+        """
+        if other is self or other is None:
+            return
+        if self.observed_steps > 0 or self._ewma_token_ms is not None:
+            return  # already warm: keep the fresher local estimate
+        self._ewma_token_ms = other._ewma_token_ms
+        self.observed_steps = other.observed_steps
+        if self.spec_acceptance is None:
+            self.spec_acceptance = other.spec_acceptance
+        self._tenant_ewma_ms.update(other._tenant_ewma_ms)
 
     # ------------------------------------------------------------ estimates
     @staticmethod

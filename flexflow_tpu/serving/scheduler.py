@@ -276,6 +276,11 @@ class Request:
     # ``seq_shards_for`` picked; the fleet router and trace digest read
     # it back
     context_bucket: Optional[int] = None
+    # multi-tenant SLO tiers (ISSUE 19, docs/multitenant.md): the tier
+    # label the fleet door's weighted fair queue and per-tenant ledgers
+    # key on. None = untenanted — scheduled under the standard tier's
+    # parameters, aggregate-only accounting (pre-tenant behavior)
+    tenant: Optional[str] = None
 
     @property
     def prefilling(self) -> bool:

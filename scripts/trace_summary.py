@@ -259,8 +259,8 @@ def summarize_telemetry(data, top: int) -> None:
         # how hard the resilience layer had to work
         oc = sr.get("outcomes", {})
         parts = [f"{k}={oc[k]}" for k in
-                 ("ok", "deadline_exceeded", "shed", "decode_fault",
-                  "preempted") if oc.get(k)]
+                 ("ok", "deadline_exceeded", "shed", "quota_exceeded",
+                  "decode_fault", "preempted") if oc.get(k)]
         line = "serving resilience: " + (" ".join(parts) or "no outcomes")
         if sr.get("shed_rate"):
             line += f"   shed rate {sr['shed_rate']}"
@@ -280,8 +280,8 @@ def summarize_telemetry(data, top: int) -> None:
         # failover/hedging/health machinery worked
         oc = fl.get("outcomes", {})
         parts = [f"{k}={oc[k]}" for k in
-                 ("ok", "deadline_exceeded", "shed", "decode_fault",
-                  "preempted") if oc.get(k)]
+                 ("ok", "deadline_exceeded", "shed", "quota_exceeded",
+                  "decode_fault", "preempted") if oc.get(k)]
         print(f"fleet: {fl.get('replicas', 0)} replicas, "
               f"{fl.get('requests', 0)} requests, "
               f"{fl.get('tokens_generated', 0)} tokens over "
@@ -301,6 +301,22 @@ def summarize_telemetry(data, top: int) -> None:
                   f"(twin wins {fl.get('hedge_twin_wins', 0)})   "
                   f"circuit opens: {fl.get('circuit_opens', 0)}   "
                   f"probes: {fl.get('probes', 0)}")
+        # multi-tenant rows (ISSUE 19): absent on pre-tenant files —
+        # this block simply doesn't print then
+        for t, row in sorted((fl.get("tenants") or {}).items()):
+            toc = row.get("outcomes", {})
+            tparts = " ".join(f"{k}={v}" for k, v in sorted(toc.items()))
+            print(f"  tenant {t}: {row.get('requests', 0)} requests, "
+                  f"{row.get('tokens', 0)} tokens   "
+                  + (tparts or "no outcomes"))
+        asc = fl.get("autoscale")
+        if asc:
+            print(f"  autoscale: {asc.get('ups', 0)} up / "
+                  f"{asc.get('downs', 0)} down"
+                  + (f"   quota sheds: {fl['quota_sheds']}"
+                     if fl.get("quota_sheds") else ""))
+        elif fl.get("quota_sheds"):
+            print(f"  quota sheds: {fl['quota_sheds']}")
 
     _block(data, "fleet", _fleet)
 
@@ -357,6 +373,30 @@ def _request_digest(reqs) -> None:
     if ttfts:
         print(f"  TTFT p50/p99: {_pctl(ttfts, .5):.2f}/"
               f"{_pctl(ttfts, .99):.2f} ms")
+    # per-tenant digest (ISSUE 19): per-tier TTFT tail + outcome split.
+    # Pre-tenant trace files carry no "tenant" key (or null) — the block
+    # degrades to nothing, by design
+    by_tenant = {}
+    for r in reqs:
+        t = r.get("tenant")
+        if t:
+            by_tenant.setdefault(t, []).append(r)
+    if by_tenant:
+        print("  per-tenant:")
+        for t, rs in sorted(by_tenant.items()):
+            tt = [float(r["first_token_ms"]) - float(r["arrival_ms"])
+                  for r in rs if r.get("first_token_ms")
+                  and r.get("arrival_ms") is not None]
+            ocs = {}
+            for r in rs:
+                k = r.get("outcome") or "?"
+                ocs[k] = ocs.get(k, 0) + 1
+            line = (f"    {t:12s} {len(rs):5d} req   TTFT p50/p99: "
+                    + (f"{_pctl(tt, .5):.2f}/{_pctl(tt, .99):.2f} ms"
+                       if tt else "-/-"))
+            line += "   " + " ".join(f"{k}={v}"
+                                     for k, v in sorted(ocs.items()))
+            print(line)
     dropped = sum(int(r.get("dropped_notes") or 0) for r in reqs)
     if dropped:
         print(f"  WARNING: {dropped} trace notes dropped "
