@@ -337,6 +337,20 @@ class FFConfig:
     # 0 = default to the initial fleet size / twice it
     min_replicas: int = 0
     max_replicas: int = 0
+    # crash-durable serving (flexflow_tpu/serving/journal.py,
+    # docs/durability.md; ISSUE 20). Directory for the fleet door's
+    # write-ahead request journal: submits/progress/outcomes survive a
+    # process crash and ServingFleet.recover() replays the unfinished
+    # backlog. Empty (default) = journal off, allocation-free hot path
+    request_journal: str = ""
+    # group-commit window in ms: buffered journal records are
+    # flushed+fsynced at most once per window (0 = every record is its
+    # own fsync — maximum durability, maximum overhead)
+    journal_sync_ms: float = 0.0
+    # journal a progress record once a stream accumulates this many
+    # committed tokens (0 = submits/outcomes only; recovery restarts
+    # unfinished streams from token zero)
+    journal_commit_every: int = 0
 
     # TPU-native knobs (no reference analog)
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) or (4, 2)
@@ -616,6 +630,20 @@ class FFConfig:
                 self.min_replicas = int(_next())
             elif a == "--max-replicas":
                 self.max_replicas = int(_next())
+            elif a == "--request-journal":
+                self.request_journal = _next()
+            elif a == "--journal-sync-ms":
+                v = float(_next())
+                if v < 0:
+                    raise ValueError(
+                        f"--journal-sync-ms must be >= 0, got {v:g}")
+                self.journal_sync_ms = v
+            elif a == "--journal-commit-every":
+                v = int(_next())
+                if v < 0:
+                    raise ValueError(
+                        f"--journal-commit-every must be >= 0, got {v}")
+                self.journal_commit_every = v
             elif a == "--rollback-lr-factor":
                 self.rollback_lr_factor = float(_next())
             elif a == "--max-rollbacks":
@@ -808,6 +836,18 @@ class FFConfig:
             raise ValueError(
                 f"--max-replicas ({self.max_replicas}) must be >= "
                 f"--min-replicas ({self.min_replicas})")
+        if "--request-journal" in seen and not self.request_journal:
+            raise ValueError(
+                "--request-journal needs a directory path: it is where "
+                "the fleet door's write-ahead request journal lives "
+                "(docs/durability.md)")
+        if ("--journal-sync-ms" in seen or
+                "--journal-commit-every" in seen) \
+                and not self.request_journal:
+            raise ValueError(
+                "--journal-sync-ms/--journal-commit-every tune the "
+                "write-ahead request journal and are only meaningful "
+                "with --request-journal DIR")
         if "--virtual-stages" in seen:
             if self.pipeline_virtual_stages < 2:
                 raise ValueError(

@@ -43,9 +43,17 @@ import os
 import re
 import shutil
 import threading
-import zlib
 from queue import Queue
 from typing import Any, Dict, List, Optional, Tuple
+
+# low-level durable-io idioms extracted to utils/durable_io.py (ISSUE 20:
+# one implementation shared with the serving request journal); the old
+# underscore names stay importable — they are this module's API to the
+# chaos harness and the resilience tests
+from ..utils.durable_io import (STALE_TMP_AGE_S,  # noqa: F401
+                                crc_file as _crc_file,
+                                fsync_path as _fsync_path,
+                                write_json as _write_json)
 
 COMMIT_MARKER = "COMMIT"
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -54,42 +62,6 @@ _FORMAT_VERSION = 1
 
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint failed commit-marker or checksum validation."""
-
-
-# --------------------------------------------------------------- low-level io
-def _fsync_path(path: str) -> None:
-    """fsync a file or directory; directory fsync persists the entry names
-    (the rename-based commit is only durable once the parent dir is)."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass  # some filesystems refuse dir fsync; commit still atomic
-    finally:
-        os.close(fd)
-
-
-def _write_json(path: str, obj, fsync: bool = True) -> None:
-    with open(path, "w") as f:
-        json.dump(obj, f)
-        if fsync:
-            f.flush()
-            os.fsync(f.fileno())
-
-
-def _crc_file(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
-    crc, size = 0, 0
-    with open(path, "rb") as f:
-        while True:
-            buf = f.read(chunk)
-            if not buf:
-                break
-            crc = zlib.crc32(buf, crc)
-            size += len(buf)
-    return crc & 0xFFFFFFFF, size
 
 
 def _payload_files(root: str) -> List[str]:
@@ -312,17 +284,12 @@ def latest_checkpoint(directory: str, verify: bool = False
     return None
 
 
-# a foreign .tmp staging dir is only swept once it has sat untouched this
-# long — a replacement process resuming during its predecessor's SIGTERM
-# grace window must not race a LIVE writer's staging out from under it
-STALE_TMP_AGE_S = 15 * 60
-
-
 def prune_checkpoints(directory: str, keep: int) -> List[str]:
     """Delete all but the newest ``keep`` committed checkpoints; also sweeps
     ``.tmp`` staging dirs from dead writers (other pids, untouched for
-    ``STALE_TMP_AGE_S``). Returns removed paths."""
-    import time
+    ``STALE_TMP_AGE_S``) via the shared ``utils.durable_io`` sweep.
+    Returns removed paths."""
+    from ..utils.durable_io import sweep_stale_tmp
 
     removed = []
     if keep <= 0 or not os.path.isdir(directory):
@@ -331,17 +298,7 @@ def prune_checkpoints(directory: str, keep: int) -> List[str]:
     for _step, path in commits[:-keep] if len(commits) > keep else []:
         shutil.rmtree(path, ignore_errors=True)
         removed.append(path)
-    now = time.time()
-    for d in os.listdir(directory):
-        if ".tmp." in d and not d.endswith(f".tmp.{os.getpid()}"):
-            p = os.path.join(directory, d)
-            try:
-                stale = now - os.path.getmtime(p) > STALE_TMP_AGE_S
-            except OSError:
-                continue  # vanished: its writer is live, leave it alone
-            if stale and os.path.isdir(p):
-                shutil.rmtree(p, ignore_errors=True)
-                removed.append(p)
+    removed.extend(sweep_stale_tmp(directory))
     return removed
 
 

@@ -75,7 +75,7 @@ _PHASE_SPANS = {
 # notes a request timeline can carry; anything else raises in note()
 # so a typo'd edge never silently vanishes from the record
 NOTE_KINDS = ("submit", "admit", "chunk", "cow", "token", "quarantine",
-              "migrate", "hedge", "finish")
+              "migrate", "hedge", "replay", "finish")
 
 # a runaway decode could otherwise grow one request's note list without
 # bound; past the cap notes are counted, not stored
@@ -250,9 +250,11 @@ class RequestTrace:
                 state = "decode"
                 ticks += 1
                 occ_sum += int(fields.get("occ", 0) or 0)
-            elif kind in ("quarantine", "migrate", "hedge"):
-                if kind != "hedge":
-                    # the primary keeps running while its hedge launches
+            elif kind in ("quarantine", "migrate", "hedge", "replay"):
+                if kind not in ("hedge", "replay"):
+                    # the primary keeps running while its hedge
+                    # launches; a replay note precedes its re-submit
+                    # (ISSUE 20) so it opens no phase of its own
                     close(ts)
                     state = "stall"
                 hops.append(dict(fields, t=round(ts, 3), kind=kind))
@@ -346,7 +348,7 @@ class RequestTrace:
                 state = "stall"
                 tracer.event_at("req_hop", ts * 1e3, tid=rid, rid=rid,
                                 hop=kind, **fields)
-            elif kind == "hedge":
+            elif kind in ("hedge", "replay"):
                 tracer.event_at("req_hop", ts * 1e3, tid=rid, rid=rid,
                                 hop=kind, **fields)
             elif kind == "finish":

@@ -187,6 +187,19 @@ class StepTelemetry:
         self.fleet_quota_sheds: int = 0
         self.fleet_autoscale_ups: int = 0
         self.fleet_autoscale_downs: int = 0
+        # request-journal counters (ISSUE 20): the ``serving_journal``
+        # block — write-ahead records appended / group-commit fsyncs /
+        # rids replayed at recovery / door dedupe hits / segments
+        # compacted away / torn-tail records truncated on open, plus the
+        # recovery wall — filled by ServingFleet._merge_telemetry when
+        # --request-journal is on
+        self.journal_appended: int = 0
+        self.journal_syncs: int = 0
+        self.journal_replayed: int = 0
+        self.journal_dedupe_hits: int = 0
+        self.journal_compacted_segments: int = 0
+        self.journal_truncated_records: int = 0
+        self.journal_recovery_wall_s: float = 0.0
         self._t_start = time.perf_counter()
 
     # -- recording ----------------------------------------------------------
@@ -387,6 +400,17 @@ class StepTelemetry:
                 "quarantines": self.serving_quarantines,
                 "drains": self.serving_drains,
                 "replans": self.serving_replans,
+            }
+        if self.journal_appended or self.journal_replayed:
+            out["serving_journal"] = {
+                "appended": self.journal_appended,
+                "syncs": self.journal_syncs,
+                "replayed": self.journal_replayed,
+                "dedupe_hits": self.journal_dedupe_hits,
+                "compacted_segments": self.journal_compacted_segments,
+                "truncated_records": self.journal_truncated_records,
+                "recovery_wall_s": round(
+                    self.journal_recovery_wall_s, 6),
             }
         return out
 

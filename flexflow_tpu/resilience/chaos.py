@@ -383,6 +383,14 @@ class FleetChaosPlan(ChaosPlan):
       ``n`` requests from one tenant (once-semantics like every other
       fleet fault), for proving WFQ isolation under a misbehaving
       neighbor.
+    * ``crash_at={tick: mode}`` — whole-PROCESS death mid-serve
+      (ISSUE 20): ``"sigkill"`` delivers a real ``SIGKILL`` to the
+      current process (run the fleet in a child process for this mode);
+      ``"hard"`` is the tier-1 CPU in-process stand-in — the fleet
+      drops its journal group-commit buffer and raises
+      :class:`~flexflow_tpu.serving.fleet.FleetCrashed` past every
+      drain/finish path, so nothing gets to flush. Recovery goes
+      through ``ServingFleet.recover()`` on the journal directory.
     """
 
     def __init__(self, kill_replica_at: Optional[dict] = None,
@@ -394,6 +402,7 @@ class FleetChaosPlan(ChaosPlan):
                  degrade_poison_every: int = 1,
                  traffic_step_at: Optional[dict] = None,
                  tenant_storm_at: Optional[dict] = None,
+                 crash_at: Optional[dict] = None,
                  storm_tenant: str = "batch",
                  fleet_storm_max_new: int = 8,
                  fleet_storm_prompt_tokens: int = 3,
@@ -417,6 +426,8 @@ class FleetChaosPlan(ChaosPlan):
         self.tenant_storm_at = {
             int(k): (str(v[0]), int(v[1]))
             for k, v in (tenant_storm_at or {}).items()}
+        self.crash_at = {int(k): str(v) for k, v in
+                         (crash_at or {}).items()}
         self.storm_tenant = str(storm_tenant)
         self.fleet_storm_max_new = int(fleet_storm_max_new)
         self.fleet_storm_prompt_tokens = int(fleet_storm_prompt_tokens)
@@ -426,6 +437,7 @@ class FleetChaosPlan(ChaosPlan):
         self.replicas_partitioned: List[int] = []
         self.replicas_drained: List[int] = []
         self.replicas_rejoined: List[int] = []
+        self.crashes_fired: List[str] = []
         self._fleet_done: set = set()
 
     def _fire(self, table: dict, tick: int, kind: str,
@@ -457,6 +469,18 @@ class FleetChaosPlan(ChaosPlan):
     def maybe_rejoin_replica(self, tick: int) -> Optional[int]:
         return self._fire(self.rejoin_at, tick, "rejoin",
                           self.replicas_rejoined)
+
+    def maybe_crash(self, tick: int) -> Optional[str]:
+        """Process-death mode to fire this tick (``"hard"`` or
+        ``"sigkill"``), or None. Same once-semantics as every other
+        fleet fault."""
+        mode = self.crash_at.get(int(tick))
+        if mode is None or (self.once and ("crash", tick) in
+                            self._fleet_done):
+            return None
+        self._fleet_done.add(("crash", tick))
+        self.crashes_fired.append(mode)
+        return mode
 
     def maybe_fleet_storm(self, tick: int) -> List[tuple]:
         """``[(tenant, n), ...]`` to inject at the fleet door this tick

@@ -188,6 +188,27 @@ def preflight_config(config) -> None:
             parse_tenant_tiers(tiers)
         except ValueError as e:
             raise PreflightError(str(e))
+    jdir = getattr(config, "request_journal", "") or ""
+    jsync = float(getattr(config, "journal_sync_ms", 0.0) or 0.0)
+    jevery = int(getattr(config, "journal_commit_every", 0) or 0)
+    if jsync < 0 or jevery < 0:
+        raise PreflightError(
+            f"--journal-sync-ms/--journal-commit-every must be >= 0 "
+            f"(got {jsync:g}/{jevery})")
+    if (jsync or jevery) and not jdir:
+        raise PreflightError(
+            "--journal-sync-ms/--journal-commit-every tune the "
+            "write-ahead request journal and are only meaningful with "
+            "--request-journal DIR (docs/durability.md)")
+    if jdir:
+        import os
+
+        parent = os.path.dirname(os.path.abspath(jdir))
+        if not os.path.isdir(parent):
+            raise PreflightError(
+                f"--request-journal parent directory does not exist: "
+                f"{parent} — the journal cannot be made durable on a "
+                "path that cannot be created")
 
 
 # --------------------------------------------------------------- strategy

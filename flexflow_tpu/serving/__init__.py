@@ -24,6 +24,9 @@ from .search import (ServingCandidate, ServingPlan,  # noqa: F401
 from .tenancy import (QuotaExceededError, TENANT_TIERS,  # noqa: F401
                       TenantPolicy, TenantRegistry, WeightedFairQueue,
                       parse_tenant_tiers)
+from .journal import (JournalCorruptError, NOOP_JOURNAL,  # noqa: F401
+                      NoopJournal, RequestJournal, journal_from_config)
 from .fleet import (CircuitBreaker, FLEET_HEALTH,  # noqa: F401
-                    FLEET_MIN_RETRY_AFTER_MS, FleetReplica, FleetStats,
-                    ServingFleet, lint_replica_plans, plan_replicas)
+                    FLEET_MIN_RETRY_AFTER_MS, FleetCrashed, FleetReplica,
+                    FleetStats, ServingFleet, lint_replica_plans,
+                    plan_replicas)

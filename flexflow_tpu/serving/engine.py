@@ -1834,10 +1834,23 @@ class _ServeLoop:
         return True
 
     # --------------------------------------------------------------- finish
-    def finish(self) -> ServingStats:
+    def finish(self, ledger_drained: bool = True) -> ServingStats:
         """Close the run exactly once: drain handoff, the outcome ledger
         (every request that entered the system leaves under exactly one
-        outcome), telemetry."""
+        outcome), telemetry.
+
+        ``ledger_drained`` (ISSUE 20 bugfix): the drain handoff used to
+        hand ``engine.drained_requests`` back with only ``outcome``
+        stamped — no reqtrace terminal — so a drained rid's timeline
+        stayed open forever across a drain followed by a crash. The
+        standalone engine path (default True) closes those timelines
+        as ``preempted`` here; the requests themselves stay clean for
+        re-submission elsewhere. The FLEET passes False: its requeue
+        branch clears ``outcome`` and re-admits the request, and
+        reqtrace's first-terminal-wins would otherwise pin a premature
+        "preempted" on a stream that goes on to finish "ok" — the fleet
+        ledgers (and journals) its own drain handoffs at ITS terminal
+        instead."""
         eng, sched, res = self.engine, self.sched, self.res
         stats, tracer = self.stats, self.tracer
         if self.finished:
@@ -1845,6 +1858,12 @@ class _ServeLoop:
         self.finished = True
         if self.draining:
             eng.drained_requests = sched.pop_queued()
+            if ledger_drained and sched.rt.enabled:
+                for r in eng.drained_requests:
+                    sched.rt.finish(r.rid, float(sched.clock()),
+                                    "preempted", reason="drain",
+                                    new_tokens=len(r.generated),
+                                    replica=sched.replica_idx)
             if tracer.enabled:
                 tracer.event("serving_drain_done",
                              returned=len(eng.drained_requests),
@@ -2047,6 +2066,6 @@ class _AsyncServeLoop(_ServeLoop):
         return True
 
     # ------------------------------------------------------------- finish
-    def finish(self) -> ServingStats:
+    def finish(self, ledger_drained: bool = True) -> ServingStats:
         self._settle_pending()
-        return super().finish()
+        return super().finish(ledger_drained=ledger_drained)

@@ -75,9 +75,11 @@ import numpy as np
 
 from ..obs.reqtrace import FleetTimeSeries, get_reqtrace
 from .engine import ServingEngine, _ServeLoop
+from .journal import NOOP_JOURNAL, RequestJournal, journal_from_config
 from .resilience import AdmissionController, OverloadError
 from .scheduler import (ContinuousBatchScheduler, QueueFullError, Request,
-                        ServingRejection, now_ms, remove_by_identity)
+                        ServingRejection, now_ms, remove_by_identity,
+                        reserve_rids)
 from .tenancy import (QuotaExceededError, TenantRegistry,
                       WeightedFairQueue)
 
@@ -89,6 +91,16 @@ FLEET_HEALTH = ("healthy", "degraded", "quarantined", "draining", "dead")
 #: hint — e.g. from a cold EWMA — invites an immediate client retry
 #: storm into a fleet that is already degraded.
 FLEET_MIN_RETRY_AFTER_MS = 50.0
+
+
+class FleetCrashed(RuntimeError):
+    """The tier-1 in-process stand-in for whole-process death
+    (``FleetChaosPlan.crash_at={tick: "hard"}``, ISSUE 20): raised from
+    inside the fleet tick so NO drain, finish or ledger path runs —
+    exactly what SIGKILL denies a real process. The journal's
+    group-commit buffer is dropped first (un-fsynced tail lost), and
+    recovery goes through :meth:`ServingFleet.recover` on the journal
+    directory."""
 
 
 class CircuitBreaker:
@@ -483,7 +495,8 @@ class ServingFleet:
                  exact_decode: bool = False,
                  plans: Optional[Sequence] = None,
                  buckets: Optional[Sequence[int]] = None,
-                 clock=None, serve_loop: Optional[str] = None):
+                 clock=None, serve_loop: Optional[str] = None,
+                 journal=None):
         assert model.executor is not None, "call model.compile() first"
         config = model.config
         n = int(n_replicas or getattr(config, "fleet_replicas", 0) or 2)
@@ -550,6 +563,13 @@ class ServingFleet:
         self._storm_seq = 0
         self.drained_requests: List[Request] = []
         self.clock = clock if clock is not None else now_ms
+        # crash-durable door (ISSUE 20, docs/durability.md): an explicit
+        # journal argument wins (recover() hands over the scanned one);
+        # otherwise --request-journal DIR builds a fresh journal; the
+        # default is the shared allocation-free NOOP_JOURNAL singleton.
+        self.journal = (journal if journal is not None
+                        else journal_from_config(config, clock=self.clock))
+        self._journal_replaying = False
         self.chaos = None
         self.stats = FleetStats(replicas=n, dispatches=[0] * n)
         self.tick_no = 0
@@ -641,7 +661,21 @@ class ServingFleet:
         queue wall) — both ``ServingRejection`` carrying the
         fleet-derived ``retry_after_ms`` — and either way the request is
         ledgered (outcome ``shed``): exactly-one-outcome holds at the
-        fleet door too."""
+        fleet door too.
+
+        Journaled mode (ISSUE 20): the submit record is WRITTEN AHEAD
+        of every admission decision, and a rid the journal has already
+        seen — a client retrying a request that survived the crash, or
+        is already finished — dedupes silently at the door instead of
+        double-admitting. Recovery replay bypasses the dedupe (the
+        replayed rids are exactly the ones already journaled)."""
+        jr = self.journal
+        if jr.enabled and not self._journal_replaying:
+            if not jr.log_submit(req):
+                # rid-keyed idempotent dedupe: this request is already
+                # journaled (pending or finished) — a retry must not
+                # enter the door twice
+                return
         self._requests.append(req)
         pol = self.tenants.policy(req.tenant)
         if req.tenant:
@@ -676,6 +710,8 @@ class ServingFleet:
             if not ok:
                 self.stats.quota_sheds += 1
                 req.outcome = "quota_exceeded"
+                if jr.enabled:
+                    jr.log_outcome(req)
                 self.stats.count_tenant_outcome(req.tenant,
                                                 "quota_exceeded")
                 if rt.enabled:
@@ -698,6 +734,8 @@ class ServingFleet:
             if total_queued >= highwater:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                if jr.enabled:
+                    jr.log_outcome(req)
                 self.stats.count_tenant_outcome(req.tenant, "shed")
                 if rt.enabled:
                     rt.finish(req.rid, float(self.clock()), "shed",
@@ -725,6 +763,8 @@ class ServingFleet:
             if est > req.deadline_ms:
                 self.stats.sheds += 1
                 req.outcome = "shed"
+                if jr.enabled:
+                    jr.log_outcome(req)
                 self.stats.count_tenant_outcome(req.tenant, "shed")
                 if rt.enabled:
                     # the PRICED estimate that made the decision rides
@@ -743,6 +783,8 @@ class ServingFleet:
         if total_queued >= self.max_queue:
             self.stats.sheds += 1
             req.outcome = "shed"
+            if jr.enabled:
+                jr.log_outcome(req)
             self.stats.count_tenant_outcome(req.tenant, "shed")
             if rt.enabled:
                 rt.finish(req.rid, float(self.clock()), "shed",
@@ -791,6 +833,12 @@ class ServingFleet:
             buckets=eng.buckets, max_len=eng.max_decode_len,
             clock=eng.resilience_clock or self.clock)
         sched.replica_idx = rep.idx  # request-trace notes carry the domain
+        if self.journal.enabled and self.journal.commit_every > 0:
+            # progress journaling rides the scheduler's commit point
+            # (--journal-commit-every tokens batch into one record);
+            # journal-off leaves on_commit None — the hot path stays
+            # one never-taken branch, allocation-free
+            sched.on_commit = self.journal.log_progress
         rep.sched = sched
         a = self._serve_args
         rep.loop = eng.start_serve(
@@ -807,6 +855,10 @@ class ServingFleet:
     def _start(self, temperature: float, top_k: int, seed: int) -> None:
         self._serve_args = {"temperature": temperature, "top_k": top_k,
                             "seed": seed}
+        if self.journal.enabled:
+            # the run record makes recovery self-contained: the exact
+            # sampling configuration rides in the journal
+            self.journal.log_run(**self._serve_args)
         for rep in self.replicas:
             if rep.loop is None:
                 self._make_loop(rep)
@@ -887,6 +939,8 @@ class ServingFleet:
             remove_by_identity(self.queue, req)
             req.outcome = "deadline_exceeded"
             req.done = True
+            if self.journal.enabled:
+                self.journal.log_outcome(req)
             if rt.enabled:
                 # dropped at the door, never reaches a scheduler _finish
                 rt.finish(req.rid, float(now), "deadline_exceeded",
@@ -949,6 +1003,8 @@ class ServingFleet:
                 # it either; one request must never crash the fleet
                 req.outcome = "preempted"
                 req.done = True
+                if self.journal.enabled:
+                    self.journal.log_outcome(req)
                 if rt.enabled:
                     rt.finish(req.rid, float(self.clock()), "preempted",
                               reason="unadmittable",
@@ -1268,12 +1324,26 @@ class ServingFleet:
     def _finish_drain(self, rep: FleetReplica) -> None:
         """A draining replica went idle: close its loop, hand its queued
         requests back (fleet-level drain) or re-route them (rolling
-        restart), and take it out of rotation."""
+        restart), and take it out of rotation.
+
+        ``ledger_drained=False``: the loop must NOT close the handed
+        requests' reqtrace timelines — the rolling-restart branch below
+        clears their outcome and re-admits them, and a premature
+        "preempted" terminal would wrongly pin (first-terminal-wins) a
+        stream that goes on to finish "ok". The fleet-level drain branch
+        IS the terminal, so it journals + ledgers there (ISSUE 20
+        satellite: a drained rid must not leak outcome-less into a
+        crash)."""
         assert rep.loop is not None
-        rep.loop.finish()
+        rep.loop.finish(ledger_drained=False)
         handed = list(rep.engine.drained_requests)
         rep.engine.drained_requests = []
         if self._fleet_draining:
+            jr = self.journal
+            if jr.enabled:
+                for req in handed:
+                    jr.log_outcome(req, "preempted")
+                jr.sync()
             self.drained_requests.extend(handed)
         else:
             for req in handed:
@@ -1450,6 +1520,11 @@ class ServingFleet:
         kill = getattr(chaos, "maybe_kill_replica", None)
         if kill is None:
             return  # a plain ChaosPlan has no fleet-replica hooks
+        crash = getattr(chaos, "maybe_crash", None)
+        if crash is not None:
+            mode = crash(tick)
+            if mode is not None:
+                self._crash(mode)
         r = chaos.maybe_kill_replica(tick)
         if r is not None:
             self._kill(self.replicas[r], "chaos_kill")
@@ -1640,6 +1715,7 @@ class ServingFleet:
                 self._resolve_hedges()
                 self._mirror_adopted()
                 self._launch_hedges()
+                self._journal_tick()
                 self.stats.tokens_history.append(self._tick_tokens)
                 self.stats.queue_depth_history.append(
                     self._waiting_requests())
@@ -1682,11 +1758,109 @@ class ServingFleet:
             session.close()
         return self._finish(t0)
 
+    def _journal_tick(self) -> None:
+        """Per-tick journal sweep (ISSUE 20): every request that
+        reached a terminal this tick gets its outcome record (placed
+        AFTER the hedge machinery — ``_resolve_hedges``/``_cancel_copy``
+        may withdraw a losing copy's outcome the same tick, and an
+        outcome record, once written, is forever), then the group-commit
+        window is checked. Journal-off cost: one attribute read."""
+        jr = self.journal
+        if not jr.enabled:
+            return
+        for req in self._requests:
+            if req.done or req.outcome:
+                jr.log_outcome(req)
+        jr.maybe_sync()
+
+    def _crash(self, mode: str) -> None:
+        """Scripted whole-process death (``FleetChaosPlan.crash_at``):
+        the journal drops its un-group-committed buffer FIRST — a dead
+        process flushes nothing — then ``sigkill`` mode delivers the
+        real signal (run the fleet in a child process for this mode)
+        while ``hard`` mode raises :class:`FleetCrashed` past every
+        drain/finish/ledger path (the tier-1 CPU stand-in). The
+        fleet_crash tracer event survives in the shared in-memory
+        tracer: the RECOVERY run's trace write publishes it."""
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("fleet_crash", tick=self.tick_no, mode=mode)
+        if self.journal.enabled:
+            self.journal.crash()
+        if mode == "sigkill":
+            import os
+            import signal as _signal
+            os.kill(os.getpid(), _signal.SIGKILL)
+        raise FleetCrashed(
+            f"fleet crashed at tick {self.tick_no} "
+            f"(chaos crash_at, mode {mode!r})")
+
+    @classmethod
+    def recover(cls, model, journal_dir: Optional[str] = None, **kw):
+        """Restart-after-crash entry point (ISSUE 20,
+        docs/durability.md): scan the journal directory (truncating any
+        torn tail), then replay every rid with a submit record but no
+        outcome record through the REAL fleet door — WFQ, tenancy,
+        quota and shed policies all apply to replayed traffic, and a
+        progress-journaled stream re-enters carrying its committed
+        tokens (the PR 11 re-prefill path resumes it bitwise under
+        exact decode). Returns the fleet with the backlog queued; call
+        :meth:`run` to serve it. The relative deadline budget restarts
+        at recovery — monotonic clocks do not survive a process."""
+        config = model.config
+        root = journal_dir or getattr(config, "request_journal", "") \
+            or ""
+        if not root:
+            raise ValueError("ServingFleet.recover() needs a journal "
+                             "directory (--request-journal DIR or "
+                             "journal_dir=)")
+        t0 = time.perf_counter()
+        jr = RequestJournal(
+            root,
+            sync_ms=float(getattr(config, "journal_sync_ms", 0.0)
+                          or 0.0),
+            commit_every=int(getattr(config, "journal_commit_every", 0)
+                             or 0),
+            clock=kw.get("clock"))
+        fleet = cls(model, journal=jr, **kw)
+        fleet._replay_journal(t0)
+        return fleet
+
+    def _replay_journal(self, t0: float) -> None:
+        jr = self.journal
+        pending = jr.pending_requests()
+        # fresh submits must never collide with a replayed rid: skip
+        # the counter past everything the dead process ever issued
+        reserve_rids(jr.max_rid())
+        rt = get_reqtrace()
+        self._journal_replaying = True
+        try:
+            for req in pending:
+                if rt.enabled:
+                    rt.note(req.rid, "replay", float(self.clock()),
+                            new_tokens=len(req.generated),
+                            tenant=req.tenant)
+                jr.replayed += 1
+                try:
+                    self.submit(req)
+                except ServingRejection:
+                    pass  # door policies hold for replayed traffic too
+        finally:
+            self._journal_replaying = False
+        jr.recovery_wall_s = time.perf_counter() - t0
+        tracer = self._tracer()
+        if tracer.enabled:
+            tracer.event("journal_recover", replayed=jr.replayed,
+                         truncated=jr.truncated_records,
+                         wall_s=round(jr.recovery_wall_s, 6))
+
     def _finish(self, t0: float) -> FleetStats:
         st = self.stats
         for rep in self.replicas:
             if rep.loop is not None and not rep.loop.finished:
-                rep.loop.finish()
+                # ledger_drained=False: the fleet-wide sweep below is
+                # the one place fleet requests' timelines close
+                rep.loop.finish(ledger_drained=False)
         # a fleet-level drain hands the door queue back too
         leftovers = list(self.queue)
         self.queue.clear()
@@ -1710,8 +1884,13 @@ class ServingFleet:
         st.tenant_outcomes = {}
         st.tenant_tokens = {}
         rt = get_reqtrace()
+        jr = self.journal
         for req in self._requests:
             outcome = req.outcome or ("ok" if req.done else "preempted")
+            if jr.enabled:
+                # the journal's exactly-one-outcome terminal mirrors the
+                # ledger's (idempotent: ticked-in outcomes drop here)
+                jr.log_outcome(req, outcome)
             st.count_outcome(outcome)
             st.count_tenant_outcome(req.tenant, outcome)
             if req.tenant and req.generated:
@@ -1749,8 +1928,17 @@ class ServingFleet:
             st.host_bookkeep_s += b
             st.host_overlap_s += o
             st.host_syncs += n
-        self._merge_telemetry(st)
         tracer = self._tracer()
+        if jr.enabled:
+            # group-commit the ledger tail, then drop fully-retired
+            # segments; close() stays with the CALLER — a fleet object
+            # may run() again (rolling batches share one journal)
+            jr.sync()
+            dropped = jr.compact()
+            if tracer.enabled and dropped:
+                tracer.event("journal_compact", segments=dropped,
+                             tick=self.tick_no)
+        self._merge_telemetry(st)
         if tracer.enabled and self.model.config.trace_file:
             tracer.write(self.model.config.trace_file)
         return st
@@ -1791,6 +1979,15 @@ class ServingFleet:
         tel.fleet_quota_sheds = st.quota_sheds
         tel.fleet_autoscale_ups = st.autoscale_ups
         tel.fleet_autoscale_downs = st.autoscale_downs
+        jr = self.journal
+        if jr.enabled:
+            tel.journal_appended = jr.appended
+            tel.journal_syncs = jr.syncs
+            tel.journal_replayed = jr.replayed
+            tel.journal_dedupe_hits = jr.dedupe_hits
+            tel.journal_compacted_segments = jr.compacted_segments
+            tel.journal_truncated_records = jr.truncated_records
+            tel.journal_recovery_wall_s = jr.recovery_wall_s
         tel.finalize()
         if self.model.config.telemetry_file:
             tel.write(self.model.config.telemetry_file)

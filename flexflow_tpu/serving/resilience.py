@@ -59,7 +59,10 @@ from .scheduler import (ContinuousBatchScheduler, Request,
                         ServingRejection, now_ms)
 
 #: terminal request dispositions — every request that enters the system
-#: leaves it under exactly one of these (asserted end-to-end in tier-1)
+#: leaves it under exactly one of these (asserted end-to-end in tier-1).
+#: The write-ahead request journal (serving/journal.py) persists exactly
+#: these strings in its ``outcome`` records; recovery replay relies on
+#: any journaled ``o`` field being a member of this tuple.
 OUTCOMES = ("ok", "deadline_exceeded", "shed", "quota_exceeded",
             "decode_fault", "preempted")
 

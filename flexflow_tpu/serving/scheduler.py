@@ -29,6 +29,17 @@ from ..obs.reqtrace import get_reqtrace
 _req_counter = itertools.count(1)
 
 
+def reserve_rids(past: int) -> None:
+    """Advance the process-wide rid counter past ``past`` (ISSUE 20):
+    journal recovery replays requests under their ORIGINAL rids, so the
+    counter must skip every rid the dead process ever issued or a fresh
+    submit would collide with a replayed one. Monotone — never moves
+    the counter backwards."""
+    global _req_counter
+    cur = next(_req_counter)  # consumed value is re-issued by count()
+    _req_counter = itertools.count(max(cur, int(past) + 1))
+
+
 def now_ms() -> float:
     """Default monotonic time base (ms) for deadline/drain decisions —
     ONE definition shared by the scheduler and the resilience policy so
@@ -404,6 +415,12 @@ class ContinuousBatchScheduler:
         self.allocator: Optional[BlockAllocator] = None
         self.max_context: Optional[int] = None
         self.on_slot_freed = None
+        # on_commit fires once per committed token, at THE commit point
+        # (ISSUE 20): the fleet points it at the request journal's
+        # progress writer when --journal-commit-every is on, so a
+        # journaled token prefix is always a prefix of the real stream.
+        # None (the default) keeps the journal-off hot path branch-only.
+        self.on_commit = None
         # prefix cache + chunked prefill (ISSUE 14): the paged engine
         # attaches its radix-tree PrefixCache and --prefill-chunk-tokens
         # here; admission walks the trie, maps the hit into the slot's
@@ -651,6 +668,8 @@ class ContinuousBatchScheduler:
             self.rt.note(req.rid, "token", float(self.clock()),
                          occ=self.n_slots - len(self._free),
                          replica=self.replica_idx)
+        if self.on_commit is not None:
+            self.on_commit(req)
         if req.eos_id is not None and int(token) == int(req.eos_id):
             return self._finish(slot, "eos")
         if len(req.generated) >= req.max_new_tokens:

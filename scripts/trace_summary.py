@@ -320,6 +320,26 @@ def summarize_telemetry(data, top: int) -> None:
 
     _block(data, "fleet", _fleet)
 
+    def _journal(j):
+        # crash-durability headline (ISSUE 20): how much the write-ahead
+        # request journal worked, whether this run was a recovery, and
+        # how quickly the backlog got back through the door. Pre-journal
+        # telemetry files carry no "serving_journal" block, so this
+        # simply doesn't print on them.
+        line = (f"request journal: {j.get('appended', 0)} records, "
+                f"{j.get('syncs', 0)} group commits")
+        if j.get("dedupe_hits"):
+            line += f"   dedupe hits {j['dedupe_hits']}"
+        if j.get("compacted_segments"):
+            line += f"   compacted {j['compacted_segments']} segment(s)"
+        print(line)
+        if j.get("replayed") or j.get("truncated_records"):
+            print(f"  recovery: {j.get('replayed', 0)} rids replayed in "
+                  f"{j.get('recovery_wall_s', 0)} s   torn-tail records "
+                  f"truncated: {j.get('truncated_records', 0)}")
+
+    _block(data, "serving_journal", _journal)
+
     def _loss(losses):
         show = losses[:top]
         print(f"loss: first {len(show)} of {len(losses)}: "
